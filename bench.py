@@ -1,0 +1,197 @@
+"""Benchmark: the reference's headline workload on TPU.
+
+Workload (BASELINE.md): the 84-ToA extraction of the 1E 2259+586 campaign —
+brute global grid + refine + likelihood-profile errors at phShiftRes=1000 —
+which takes the reference ~202 s (~0.4158 ToA/s) on CPU
+(/root/reference/data/ToAs_2259.log), plus a 1e5-trial Z^2 scan
+(BASELINE.json config 2).
+
+The merged ~1-yr event file is absent from the reference snapshot
+(.MISSING_LARGE_BLOBS), so the dataset is a synthetic surrogate shaped to
+the committed interval table (tests/data/timIntToAs_1e2259.txt): 10^4
+events per ToA drawn from the committed template profile, placed in the
+committed [start, end] windows so the full pipeline (anchored fold ->
+batched fit -> error scans -> H-test) runs end to end.
+
+Prints ONE JSON line: ToAs/sec with vs_baseline against the reference's
+0.4158 ToA/s. Z^2 trial throughput goes to stderr as context.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+REFERENCE_TOAS_PER_SEC = 84 / 202.0  # data/ToAs_2259.log timestamps
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_surrogate(par_path: str, intervals_path: str, template_path: str, events_per_toa: int = 10000, seed: int = 7):
+    """Synthetic merged-campaign events shaped to the committed intervals."""
+    import pandas as pd
+
+    from crimp_tpu.io import template as template_io
+    from crimp_tpu.models import timing, profiles
+    from crimp_tpu.ops import anchored
+    from crimp_tpu.ops.ephem import spin_frequency_host
+
+    rng = np.random.RandomState(seed)
+    intervals = pd.read_csv(intervals_path, sep=r"\s+", comment="#")
+    tm = timing.resolve(par_path)
+    tpl_dict = template_io.read_template(template_path)
+    kind, tpl = profiles.from_template(tpl_dict)
+
+    amp = np.asarray(tpl.amp)
+    loc = np.asarray(tpl.loc)
+    norm = float(tpl.norm)
+
+    def profile_rate(p):
+        j = np.arange(1, len(amp) + 1)[:, None]
+        return norm + np.sum(amp[:, None] * np.cos(j * 2 * np.pi * p[None, :] + loc[:, None]), axis=0)
+
+    all_times = []
+    for _, row in intervals.iterrows():
+        t_start, t_end = row["ToA_tstart"], row["ToA_tend"]
+        t_mid = (t_start + t_end) / 2
+        # draw folded phases from the template pdf (rejection sampling)
+        phases = np.empty(0)
+        peak = profile_rate(np.linspace(0, 1, 512)).max() * 1.02
+        while len(phases) < events_per_toa:
+            cand = rng.uniform(0, 1, 3 * events_per_toa)
+            keep = rng.uniform(0, peak, len(cand)) < profile_rate(cand)
+            phases = np.concatenate([phases, cand[keep]])
+        phases = phases[:events_per_toa]
+        # invert the (locally linear) phase model around the window mid
+        f_mid, _ = spin_frequency_host(tm, np.atleast_1d(t_mid))
+        f_mid = float(f_mid[0])
+        phi_mid = float(anchored.host_total_phase(tm, np.atleast_1d(t_mid))[0])
+        frac_mid = phi_mid - np.floor(phi_mid)
+        span_cycles = (t_end - t_start) * 86400.0 * f_mid
+        k = rng.randint(int(-span_cycles / 2), max(int(span_cycles / 2), 1), events_per_toa)
+        t = t_mid + ((k + phases - frac_mid) / f_mid) / 86400.0
+        t = t[(t >= t_start) & (t <= t_end)]
+        all_times.append(t)
+    return np.sort(np.concatenate(all_times)), intervals
+
+
+def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np.ndarray, intervals) -> dict:
+    """Batched ToA extraction over the committed 84 intervals."""
+    import jax.numpy as jnp
+
+    from crimp_tpu.io import template as template_io
+    from crimp_tpu.models import profiles, timing
+    from crimp_tpu.ops import anchored, search, toafit
+    from crimp_tpu.ops.ephem import spin_frequency_host
+
+    tm = timing.resolve(par_path)
+    tpl_dict = template_io.read_template(template_path)
+    kind, tpl = profiles.from_template(tpl_dict)
+
+    def run_once():
+        starts = intervals["ToA_tstart"].to_numpy()
+        ends = intervals["ToA_tend"].to_numpy()
+        exposures = intervals["ToA_exposure"].to_numpy().astype(float)
+        toa_mids = np.zeros(len(intervals))
+        seg_times = []
+        for i in range(len(intervals)):
+            sel = (times >= starts[i]) & (times <= ends[i])
+            t_seg = times[sel]
+            toa_mids[i] = (t_seg[-1] - t_seg[0]) / 2 + t_seg[0]
+            seg_times.append(t_seg)
+        am = anchored.prepare_anchors(tm, toa_mids)
+        seg_sizes = [t.size for t in seg_times]
+        anchor_idx = np.repeat(np.arange(len(seg_times)), seg_sizes)
+        delta_all = anchored.anchor_deltas(np.concatenate(seg_times), toa_mids, anchor_idx)
+        folded_all = np.asarray(
+            anchored.anchored_fold(am, jnp.asarray(delta_all), jnp.asarray(anchor_idx))
+        )
+        seg_phases = list(np.split(folded_all, np.cumsum(seg_sizes)[:-1]))
+        phases, masks = toafit.pad_segments(seg_phases)
+        cfg = toafit.ToAFitConfig(kind=kind, ph_shift_res=1000, nbins=15)
+        fit = toafit.fit_toas_batch(kind, tpl, phases, masks, exposures, cfg)
+        fit = {k: np.asarray(v) for k, v in fit.items()}
+        # per-ToA H-test at the local ephemeris frequency
+        freqs_mid, _ = spin_frequency_host(tm, toa_mids)
+        sec = np.zeros_like(phases)
+        msk = np.zeros_like(masks)
+        for i, t_seg in enumerate(seg_times):
+            sec[i, : t_seg.size] = (t_seg - (t_seg[0] + t_seg[-1]) / 2) * 86400.0
+            msk[i, : t_seg.size] = True
+        fit["Hpower"] = np.asarray(search.h_power_segments(sec, msk, freqs_mid, nharm=5))
+        return fit
+
+    run_once()  # compile
+    t0 = time.perf_counter()
+    fit = run_once()
+    wall = time.perf_counter() - t0
+    n_toas = len(intervals)
+    return {
+        "wall_s": wall,
+        "toas_per_sec": n_toas / wall,
+        "n_toas": n_toas,
+        "median_abs_phshift": float(np.median(np.abs(fit["phShift"]))),
+        "median_err": float(np.median(fit["phShift_UL"])),
+        "median_H": float(np.median(fit["Hpower"])),
+    }
+
+
+def bench_z2(times: np.ndarray, n_trials: int = 100_000) -> dict:
+    """1-D Z^2_2 scan, config 2 of BASELINE.json (1e5 trials)."""
+    import jax.numpy as jnp
+
+    from crimp_tpu.ops import search
+
+    sec = (times - times.mean()) * 86400.0
+    freqs = np.linspace(0.1430, 0.1436, n_trials)
+    power = np.asarray(search.z2_power(jnp.asarray(sec), jnp.asarray(freqs[:128]), 2))  # compile
+    t0 = time.perf_counter()
+    power = np.asarray(search.z2_power(jnp.asarray(sec), jnp.asarray(freqs), 2))
+    wall = time.perf_counter() - t0
+    return {
+        "wall_s": wall,
+        "trials_per_sec": n_trials / wall,
+        "n_events": len(sec),
+        "peak": float(power.max()),
+        "peak_freq": float(freqs[int(np.argmax(power))]),
+    }
+
+
+def main():
+    import pathlib
+
+    here = pathlib.Path(__file__).parent
+    par = str(here / "tests/data/1e2259.par")
+    intervals_path = str(here / "tests/data/timIntToAs_1e2259.txt")
+    template = str(here / "tests/data/1e2259_template.txt")
+
+    log("[bench] building synthetic merged-campaign surrogate ...")
+    times, intervals = build_surrogate(par, intervals_path, template)
+    log(f"[bench] surrogate: {len(times)} events over {len(intervals)} intervals")
+
+    z2 = bench_z2(times)
+    log(f"[bench] Z^2 1e5 trials x {z2['n_events']} events: {z2['wall_s']:.2f}s "
+        f"({z2['trials_per_sec']:.0f} trials/s), peak {z2['peak']:.0f} at {z2['peak_freq']:.6f} Hz")
+
+    toas = bench_toas(par, intervals_path, template, times, intervals)
+    log(f"[bench] {toas['n_toas']} ToAs in {toas['wall_s']:.2f}s = {toas['toas_per_sec']:.1f} ToA/s "
+        f"(median |phShift| {toas['median_abs_phshift']:.4f} rad, median err {toas['median_err']:.4f}, "
+        f"median H {toas['median_H']:.0f})")
+    log(f"[bench] reference: {REFERENCE_TOAS_PER_SEC:.4f} ToA/s (202 s for 84 ToAs, data/ToAs_2259.log)")
+
+    print(json.dumps({
+        "metric": "toa_extraction_throughput_84toa_res1000",
+        "value": round(toas["toas_per_sec"], 3),
+        "unit": "ToA/s",
+        "vs_baseline": round(toas["toas_per_sec"] / REFERENCE_TOAS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from crimp_tpu.io import fitsio, parfile, template, tim, events
+
+__all__ = ["fitsio", "parfile", "template", "tim", "events"]

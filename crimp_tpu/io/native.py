@@ -1,0 +1,129 @@
+"""ctypes bindings for the native event-I/O runtime (native/crimpio.cpp).
+
+The shared library is built on demand (``make -C native``) and loaded
+lazily; every caller must tolerate ``load() is None`` and fall back to the
+pure-Python FITS layer — the native path is a large-file accelerator, not a
+correctness dependency."""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+
+import numpy as np
+
+from crimp_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
+_LIB_PATH = _NATIVE_DIR / "libcrimpio.so"
+_lib = None
+_load_attempted = False
+
+
+def load() -> ctypes.CDLL | None:
+    """The loaded library, building it first if necessary; None on failure."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    try:
+        if not _LIB_PATH.exists():
+            subprocess.run(
+                ["make", "-C", str(_NATIVE_DIR)], check=True, capture_output=True
+            )
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except (OSError, subprocess.CalledProcessError) as exc:
+        logger.info("native crimpio unavailable (%s); using pure-Python FITS path", exc)
+        return None
+
+    lib.cio_open.argtypes = [ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.cio_open.restype = ctypes.c_int
+    lib.cio_close.argtypes = [ctypes.c_void_p]
+    lib.cio_find_hdu.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.cio_find_hdu.restype = ctypes.c_int
+    lib.cio_n_rows.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.cio_n_rows.restype = ctypes.c_long
+    lib.cio_read_column_f64.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.cio_read_column_f64.restype = ctypes.c_int
+    lib.cio_filter_energy.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double), ctypes.c_long,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+    ]
+    lib.cio_filter_energy.restype = ctypes.c_long
+    lib.cio_phase_histogram.argtypes = [
+        ctypes.POINTER(ctypes.c_double), ctypes.c_long, ctypes.c_double, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.cio_phase_histogram.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def _as_double_ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def read_columns(path: str, extname: str, columns: list[str]) -> dict[str, np.ndarray] | None:
+    """Read scalar columns from a BINTABLE extension; None if unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    handle = ctypes.c_void_p()
+    if lib.cio_open(path.encode(), ctypes.byref(handle)) != 0:
+        return None
+    try:
+        hdu = lib.cio_find_hdu(handle, extname.encode())
+        if hdu < 0:
+            return None
+        n = lib.cio_n_rows(handle, hdu)
+        if n < 0:
+            return None
+        out = {}
+        for column in columns:
+            buf = np.empty(n, dtype=np.float64)
+            status = lib.cio_read_column_f64(handle, hdu, column.encode(), _as_double_ptr(buf))
+            if status != 0:
+                return None
+            out[column] = buf
+        return out
+    finally:
+        lib.cio_close(handle)
+
+
+def filter_energy(
+    time: np.ndarray, pi: np.ndarray, scale: float, offset: float, lo: float, hi: float
+):
+    """Fused PI->keV conversion + band selection; None if unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    time = np.ascontiguousarray(time, dtype=np.float64)
+    pi = np.ascontiguousarray(pi, dtype=np.float64)
+    time_out = np.empty_like(time)
+    kev_out = np.empty_like(pi)
+    kept = lib.cio_filter_energy(
+        _as_double_ptr(time), _as_double_ptr(pi), len(time),
+        scale, offset, lo, hi, _as_double_ptr(time_out), _as_double_ptr(kev_out),
+    )
+    return time_out[:kept], kev_out[:kept]
+
+
+def phase_histogram(phases: np.ndarray, upper: float, nbins: int) -> np.ndarray | None:
+    """Counts histogram of phases over [0, upper); None if unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    phases = np.ascontiguousarray(phases, dtype=np.float64)
+    counts = np.zeros(nbins, dtype=np.int64)
+    lib.cio_phase_histogram(
+        _as_double_ptr(phases), len(phases), upper, nbins,
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return counts

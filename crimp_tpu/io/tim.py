@@ -1,0 +1,95 @@
+"""tempo2/PINT FORMAT-1 ``.tim`` ToA files (read/write).
+
+Format parity with the reference (timfile.py:25-161): the first line is
+``FORMAT 1``; each data line is
+``template frequency toa_mjd toa_err_us site [-flag value ...]`` with one
+leading space, ``C`` comments, and trailing flag pairs (``-i``, ``-pn``; the
+``pn`` pulse-number column is coerced to integer).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+FIXED_COLUMNS = ["template", "frequency", "pulse_ToA", "pulse_ToA_err", "time_ref"]
+
+
+def read_tim(path: str, comment: str = "C", skiprows: int = 1) -> pd.DataFrame:
+    """Read a .tim file into a DataFrame with fixed + flag columns."""
+    rows = []
+    with open(path, "r") as fh:
+        for i, raw in enumerate(fh):
+            if i < skiprows:
+                continue
+            line = raw.strip()
+            if not line or line.startswith(comment):
+                continue
+            rows.append(line.split())
+    records = []
+    for tokens in rows:
+        rec = dict(zip(FIXED_COLUMNS, tokens[:5]))
+        extras = tokens[5:]
+        j = 0
+        while j < len(extras):
+            tok = extras[j]
+            if tok.startswith("-"):
+                key = tok.lstrip("-")
+                rec[f"{key}_flag"] = tok
+                rec[key] = extras[j + 1] if j + 1 < len(extras) else None
+                j += 2
+            else:
+                j += 1
+        records.append(rec)
+    df = pd.DataFrame(records)
+    for col in ["frequency", "pulse_ToA", "pulse_ToA_err"]:
+        if col in df.columns:
+            df[col] = pd.to_numeric(df[col], errors="coerce")
+    if "pn" in df.columns:
+        df["pn"] = pd.to_numeric(df["pn"], errors="coerce").astype("Int64")
+    return df
+
+
+def write_tim(path_stem: str, df: pd.DataFrame, clobber: bool = False) -> str:
+    """Write a ToA DataFrame as ``<path_stem>.tim`` (FORMAT 1)."""
+    path = path_stem + ".tim"
+    mode = "w" if clobber else "x"
+    with open(path, mode) as fh:
+        fh.write("FORMAT 1\n")
+        for _, row in df.iterrows():
+            fields = [str(v) for v in row.tolist() if v is not None and v == v]
+            fh.write(" " + " ".join(fields) + "\n")
+    return path
+
+
+class PulseToAs:
+    """DataFrame wrapper for .tim content: reset / time filter / write."""
+
+    def __init__(self, pulsetoas: pd.DataFrame):
+        self._original = pulsetoas.copy()
+        self.df = pulsetoas.copy()
+
+    def reset(self) -> "PulseToAs":
+        self.df = self._original.copy()
+        return self
+
+    def time_filter(
+        self,
+        t_start: float | None = None,
+        t_end: float | None = None,
+        inplace: bool = True,
+    ):
+        lo = -np.inf if t_start is None else t_start
+        hi = np.inf if t_end is None else t_end
+        mask = self.df["pulse_ToA"].between(lo, hi)
+        if inplace:
+            self.df = self.df.loc[mask].copy()
+            return self
+        return self.df.loc[mask].copy()
+
+    def writetimfile(self, timfilename: str, clobber: bool = False) -> None:
+        write_tim(timfilename, self.df, clobber=clobber)
+
+
+# Reference-named alias.
+readtimfile = read_tim

@@ -1,0 +1,4 @@
+from crimp_tpu.models.timing import TimingParams
+from crimp_tpu.models import profiles
+
+__all__ = ["TimingParams", "profiles"]

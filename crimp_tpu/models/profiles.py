@@ -1,0 +1,204 @@
+"""Pulse-profile template families as JAX functions on a dense pytree.
+
+The three families mirror the reference templates (templatemodels.py:24-329):
+
+- Fourier series on phases in cycles [0,1):
+    f(x) = norm + sum_j amp_j*ampShift * cos(j*2pi*x + ph_j - j*phShift)
+- wrapped Cauchy (Lorentzian) on phases in radians [0,2pi):
+    f(x) = norm + sum_j amp_j*ampShift/(2pi) * sinh(wid_j) /
+                  (cosh(wid_j) - cos(x - cen_j - phShift))
+- von Mises (wrapped Gaussian) on phases in radians:
+    f(x) = norm + sum_j amp_j*ampShift/(2pi*I0(1/wid_j^2)) *
+                  exp(cos(x - cen_j - phShift)/wid_j^2)
+
+and the two likelihoods each family carries:
+
+- a binned Gaussian log-likelihood for template construction,
+- an unbinned extended Poisson log-likelihood for ToA extraction, with the
+  reference's -inf guard when the normalized model goes non-positive
+  (templatemodels.py:113-115,220-222,324-326) implemented mask-safely so a
+  bad batch element cannot NaN-poison a vmap.
+
+Parameters live in a fixed-shape ProfileParams pytree so fits vmap over ToA
+segments. ``phShift``/``ampShift`` are the ToA observables.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import i0
+
+FOURIER = "fourier"
+CAUCHY = "cauchy"
+VONMISES = "vonmises"
+KINDS = (FOURIER, CAUCHY, VONMISES)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ProfileParams:
+    """Dense template parameters; ``loc`` is ph_k (Fourier) or cen_k."""
+
+    norm: jax.Array  # scalar
+    amp: jax.Array  # (K,)
+    loc: jax.Array  # (K,)
+    wid: jax.Array  # (K,) — unused (zeros) for Fourier
+    ph_shift: jax.Array  # scalar
+    amp_shift: jax.Array  # scalar
+
+    @property
+    def n_comp(self) -> int:
+        return int(self.amp.shape[-1])
+
+    def replace(self, **kw) -> "ProfileParams":
+        from dataclasses import replace as _replace
+
+        return _replace(self, **kw)
+
+
+def from_template(template: dict, ph_shift: float = 0.0, amp_shift: float = 1.0) -> tuple[str, ProfileParams]:
+    """(kind, params) from a template dict as read by io.template."""
+    kind = template["model"].casefold()
+    n = int(template["nbrComp"])
+    value = lambda key: float(template[key]["value"]) if isinstance(template[key], dict) else float(template[key])
+    amp = jnp.array([value(f"amp_{k}") for k in range(1, n + 1)])
+    if kind == FOURIER:
+        loc = jnp.array([value(f"ph_{k}") for k in range(1, n + 1)])
+        wid = jnp.zeros(n)
+    else:
+        loc = jnp.array([value(f"cen_{k}") for k in range(1, n + 1)])
+        wid = jnp.array([value(f"wid_{k}") for k in range(1, n + 1)])
+    params = ProfileParams(
+        norm=jnp.asarray(value("norm"), dtype=jnp.float64),
+        amp=amp.astype(jnp.float64),
+        loc=loc.astype(jnp.float64),
+        wid=wid.astype(jnp.float64),
+        ph_shift=jnp.asarray(ph_shift, dtype=jnp.float64),
+        amp_shift=jnp.asarray(amp_shift, dtype=jnp.float64),
+    )
+    return kind, params
+
+
+def to_theta(kind: str, params: ProfileParams) -> dict:
+    """Flat reference-style theta dict (for file writers and reports)."""
+    import numpy as np
+
+    theta = {
+        "norm": float(params.norm),
+        "phShift": float(params.ph_shift),
+        "ampShift": float(params.amp_shift),
+    }
+    for j in range(params.n_comp):
+        theta[f"amp_{j + 1}"] = float(np.asarray(params.amp)[j])
+        if kind == FOURIER:
+            theta[f"ph_{j + 1}"] = float(np.asarray(params.loc)[j])
+        else:
+            theta[f"cen_{j + 1}"] = float(np.asarray(params.loc)[j])
+            theta[f"wid_{j + 1}"] = float(np.asarray(params.wid)[j])
+    return theta
+
+
+# ---------------------------------------------------------------------------
+# Curves
+# ---------------------------------------------------------------------------
+
+
+def fourier_curve(params: ProfileParams, x: jax.Array) -> jax.Array:
+    """Fourier-series rate curve at phases x (cycles)."""
+    j = jnp.arange(1, params.n_comp + 1, dtype=x.dtype)
+    # (K, N) angles; K is small and static so the outer product stays cheap.
+    angles = j[:, None] * (2 * jnp.pi) * x[None, :] + params.loc[:, None] - j[:, None] * params.ph_shift
+    return params.norm + jnp.sum(
+        params.amp[:, None] * params.amp_shift * jnp.cos(angles), axis=0
+    )
+
+
+def cauchy_curve(params: ProfileParams, x: jax.Array) -> jax.Array:
+    """Wrapped-Cauchy rate curve at phases x (radians)."""
+    delta = x[None, :] - params.loc[:, None] - params.ph_shift
+    comps = (
+        (params.amp[:, None] * params.amp_shift / (2 * jnp.pi))
+        * jnp.sinh(params.wid[:, None])
+        / (jnp.cosh(params.wid[:, None]) - jnp.cos(delta))
+    )
+    return params.norm + jnp.sum(comps, axis=0)
+
+
+def vonmises_curve(params: ProfileParams, x: jax.Array) -> jax.Array:
+    """von Mises rate curve at phases x (radians)."""
+    kappa = 1.0 / params.wid**2
+    delta = x[None, :] - params.loc[:, None] - params.ph_shift
+    comps = (
+        params.amp[:, None]
+        * params.amp_shift
+        / (2 * jnp.pi * i0(kappa[:, None]))
+        * jnp.exp(kappa[:, None] * jnp.cos(delta))
+    )
+    return params.norm + jnp.sum(comps, axis=0)
+
+
+_CURVES = {FOURIER: fourier_curve, CAUCHY: cauchy_curve, VONMISES: vonmises_curve}
+
+
+def curve(kind: str, params: ProfileParams, x: jax.Array) -> jax.Array:
+    return _CURVES[kind](params, x)
+
+
+def extended_norm_factor(kind: str, params: ProfileParams) -> jax.Array:
+    """Normalization used by the extended likelihood.
+
+    Fourier normalizes by ``norm``; von Mises / Cauchy by
+    2*pi*norm + sum_j amp_j*ampShift (templatemodels.py:110-121, 213-226).
+    """
+    if kind == FOURIER:
+        return params.norm
+    return 2 * jnp.pi * params.norm + jnp.sum(params.amp * params.amp_shift)
+
+
+# ---------------------------------------------------------------------------
+# Likelihoods
+# ---------------------------------------------------------------------------
+
+
+def binned_loglik(kind: str, params: ProfileParams, x: jax.Array, y: jax.Array, y_err: jax.Array) -> jax.Array:
+    """Gaussian log-likelihood of binned rates y +/- y_err at phases x."""
+    model = curve(kind, params, x)
+    resid = (y - model) / y_err
+    return jnp.sum(-0.5 * resid**2 - 0.5 * jnp.log(2 * jnp.pi * y_err**2))
+
+
+def extended_loglik(
+    kind: str,
+    params: ProfileParams,
+    x: jax.Array,
+    exposure: jax.Array,
+    mask: jax.Array | None = None,
+) -> jax.Array:
+    """Unbinned extended Poisson log-likelihood of event phases x.
+
+    ``mask`` marks valid events (for padded/bucketed ragged segments);
+    returns -inf when the normalized model dips non-positive anywhere on the
+    (masked) event set, without generating NaNs.
+    """
+    model = curve(kind, params, x)
+    norm_factor = extended_norm_factor(kind, params)
+    normalized = model / norm_factor
+
+    if mask is None:
+        n_events = x.shape[-1] * jnp.ones((), dtype=x.dtype)
+        min_val = jnp.min(normalized)
+        log_sum = jnp.sum(jnp.log(jnp.clip(normalized, 1e-300)))
+    else:
+        n_events = jnp.sum(mask)
+        min_val = jnp.min(jnp.where(mask, normalized, jnp.inf))
+        log_sum = jnp.sum(jnp.where(mask, jnp.log(jnp.clip(normalized, 1e-300)), 0.0))
+
+    if kind == FOURIER:
+        expected = params.norm * exposure
+    else:
+        expected = norm_factor * exposure / (2 * jnp.pi)
+    value = -expected + n_events * jnp.log(expected) + log_sum
+    return jnp.where(min_val <= 0, -jnp.inf, value)

@@ -1,0 +1,158 @@
+"""Timing model as a dense JAX pytree (struct-of-arrays).
+
+The reference carries timing models as string-keyed dicts
+(readtimingmodel.py:212-233) which cannot be traced or vmapped. Here the
+model is a fixed-shape pytree — F0..F12 as a (13,) vector, glitches as
+padded (G,) columns, whitening waves as padded (W,) A/B coefficient
+vectors — so phase folding jits once and vmaps over models (needed for the
+ensemble-MCMC timing fits) as well as over event batches.
+
+Padding conventions (mask-safe under jit/vmap):
+- unused glitch rows have GLEP = +inf (the ``t >= GLEP`` mask is never true)
+  and GLTD = 1 (avoids 0/0 in the recovery term; same default as the
+  reference reader, readtimingmodel.py:120);
+- unused wave harmonics have A = B = 0.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crimp_tpu.io.parfile import get_parameter_value, read_timing_model
+
+N_FREQ_TERMS = 13  # F0..F12
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TimingParams:
+    """Dense, jittable timing model: Taylor spin terms + glitches + waves."""
+
+    pepoch: jax.Array  # scalar, MJD
+    f: jax.Array  # (13,) frequency and derivatives F0..F12
+    glep: jax.Array  # (G,) glitch epochs, MJD (+inf padding)
+    glph: jax.Array  # (G,) phase jumps
+    glf0: jax.Array  # (G,) frequency jumps
+    glf1: jax.Array  # (G,) fdot jumps
+    glf2: jax.Array  # (G,) fddot jumps
+    glf0d: jax.Array  # (G,) decaying frequency jumps
+    gltd: jax.Array  # (G,) recovery timescales, days (1.0 padding)
+    wave_epoch: jax.Array  # scalar, MJD
+    wave_om: jax.Array  # scalar, wave fundamental (rad/day)
+    wave_a: jax.Array  # (W,) sine coefficients (0 padding)
+    wave_b: jax.Array  # (W,) cosine coefficients (0 padding)
+
+    @property
+    def n_glitch(self) -> int:
+        return int(self.glep.shape[-1])
+
+    @property
+    def n_wave(self) -> int:
+        return int(self.wave_a.shape[-1])
+
+
+def _value(entry) -> float:
+    return float(get_parameter_value(entry))
+
+
+def from_dict(params: dict, n_glitch: int | None = None, n_wave: int | None = None) -> TimingParams:
+    """Build a TimingParams pytree from a reference-style parameter dict.
+
+    Accepts both dict shapes ({key: value} and {key: {'value','flag'}}).
+    ``n_glitch``/``n_wave`` set padded sizes (for bucketing models of
+    different complexity to one compiled shape).
+    """
+    f = np.zeros(N_FREQ_TERMS)
+    for i in range(N_FREQ_TERMS):
+        if f"F{i}" in params:
+            f[i] = _value(params[f"F{i}"])
+    pepoch = _value(params.get("PEPOCH", 0.0))
+
+    gids = []
+    for key in params:
+        match = re.match(r"GLEP_(\S+)$", key)
+        if match:
+            gids.append(match.group(1))
+    G = max(n_glitch if n_glitch is not None else 0, len(gids))
+    glitch_cols = {
+        "glep": np.full(G, np.inf),
+        "glph": np.zeros(G),
+        "glf0": np.zeros(G),
+        "glf1": np.zeros(G),
+        "glf2": np.zeros(G),
+        "glf0d": np.zeros(G),
+        "gltd": np.ones(G),
+    }
+    base_to_col = {
+        "GLEP": "glep",
+        "GLPH": "glph",
+        "GLF0": "glf0",
+        "GLF1": "glf1",
+        "GLF2": "glf2",
+        "GLF0D": "glf0d",
+        "GLTD": "gltd",
+    }
+    for j, gid in enumerate(gids):
+        for base, col in base_to_col.items():
+            key = f"{base}_{gid}"
+            if key in params:
+                glitch_cols[col][j] = _value(params[key])
+
+    # Wave harmonics: the reference covers k = 1..N where N is the number of
+    # WAVEk entries (calcphase.py:135-146 counts all WAVE* keys then iterates
+    # range(1, len-1), which lands on 1..N thanks to WAVEEPOCH and WAVE_OM).
+    wave_ks = sorted(
+        int(m.group(1)) for key in params if (m := re.match(r"WAVE(\d+)$", key))
+    )
+    W = max(n_wave if n_wave is not None else 0, len(wave_ks))
+    wave_a = np.zeros(W)
+    wave_b = np.zeros(W)
+    for idx, k in enumerate(wave_ks):
+        entry = params[f"WAVE{k}"]
+        pair = entry["value"] if isinstance(entry, dict) and "value" in entry else entry
+        wave_a[idx] = float(pair["A"])
+        wave_b[idx] = float(pair["B"])
+    wave_epoch = _value(params.get("WAVEEPOCH", 0.0))
+    wave_om = _value(params.get("WAVE_OM", 0.0))
+
+    # Leaves stay HOST-side numpy: scalars parked on this TPU lose ~2.5 ulps
+    # (emulated f64), which alone breaks the <1 µs ToA budget via PEPOCH.
+    # jit/vmap accept numpy leaves and transfer them at call time; the
+    # precision-critical paths (ops.anchored, ops.ephem host twins) read
+    # them exactly from host memory.
+    as_f64 = lambda x: np.asarray(x, dtype=np.float64)
+    return TimingParams(
+        pepoch=as_f64(pepoch),
+        f=as_f64(f),
+        glep=as_f64(glitch_cols["glep"]),
+        glph=as_f64(glitch_cols["glph"]),
+        glf0=as_f64(glitch_cols["glf0"]),
+        glf1=as_f64(glitch_cols["glf1"]),
+        glf2=as_f64(glitch_cols["glf2"]),
+        glf0d=as_f64(glitch_cols["glf0d"]),
+        gltd=as_f64(glitch_cols["gltd"]),
+        wave_epoch=as_f64(wave_epoch),
+        wave_om=as_f64(wave_om),
+        wave_a=as_f64(wave_a),
+        wave_b=as_f64(wave_b),
+    )
+
+
+def from_par(path: str, n_glitch: int | None = None, n_wave: int | None = None) -> TimingParams:
+    """Read a .par file into a TimingParams pytree."""
+    values, _, _ = read_timing_model(path)
+    return from_dict(values, n_glitch=n_glitch, n_wave=n_wave)
+
+
+def resolve(timMod) -> TimingParams:
+    """Accept a TimingParams, a parameter dict, or a .par path."""
+    if isinstance(timMod, TimingParams):
+        return timMod
+    if isinstance(timMod, dict):
+        return from_dict(timMod)
+    return from_par(str(timMod))

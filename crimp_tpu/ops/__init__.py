@@ -1,0 +1,3 @@
+from crimp_tpu.ops import fold, anchored, ephem, binprofile, search
+
+__all__ = ["fold", "anchored", "ephem", "binprofile", "search"]

@@ -1,0 +1,85 @@
+"""Affine-invariant ensemble MCMC as a pure-JAX kernel.
+
+Replaces the reference's emcee dependency (fit_toas.py:140-202,
+get_local_ephem.py:195-198) with the same algorithm — Goodman & Weare
+(2010) stretch moves over a walker ensemble — implemented as a
+``lax.scan`` over steps with the log-probability vmapped over walkers, so
+an entire 10000-step x 32-walker run is one compiled device program
+instead of 320k Python-loop model evaluations.
+
+Ensemble halves update alternately (the standard parallel-stretch scheme
+emcee also uses), keeping detailed balance while staying fully batched.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("log_prob_fn", "steps"))
+def ensemble_sample(
+    log_prob_fn,
+    p0: jax.Array,  # (walkers, ndim) initial ensemble
+    steps: int,
+    key: jax.Array,
+    stretch_a: float = 2.0,
+):
+    """Run the stretch-move ensemble; returns (chain, log_probs).
+
+    chain: (steps, walkers, ndim); log_probs: (steps, walkers).
+    """
+    n_walkers, ndim = p0.shape
+    half = n_walkers // 2
+    lp0 = jax.vmap(log_prob_fn)(p0)
+
+    def half_update(key, movers, movers_lp, others):
+        k_part, k_z, k_accept = jax.random.split(key, 3)
+        partners = others[
+            jax.random.randint(k_part, (movers.shape[0],), 0, others.shape[0])
+        ]
+        u = jax.random.uniform(k_z, (movers.shape[0],))
+        z = ((stretch_a - 1.0) * u + 1.0) ** 2 / stretch_a
+        proposal = partners + z[:, None] * (movers - partners)
+        prop_lp = jax.vmap(log_prob_fn)(proposal)
+        log_ratio = (ndim - 1) * jnp.log(z) + prop_lp - movers_lp
+        accept = jnp.log(jax.random.uniform(k_accept, (movers.shape[0],))) < log_ratio
+        new = jnp.where(accept[:, None], proposal, movers)
+        new_lp = jnp.where(accept, prop_lp, movers_lp)
+        return new, new_lp
+
+    def step(carry, key):
+        walkers, lp = carry
+        k1, k2 = jax.random.split(key)
+        first, second = walkers[:half], walkers[half:]
+        lp1, lp2 = lp[:half], lp[half:]
+        first, lp1 = half_update(k1, first, lp1, second)
+        second, lp2 = half_update(k2, second, lp2, first)
+        walkers = jnp.concatenate([first, second])
+        lp = jnp.concatenate([lp1, lp2])
+        return (walkers, lp), (walkers, lp)
+
+    keys = jax.random.split(key, steps)
+    _, (chain, lps) = jax.lax.scan(step, (p0, lp0), keys)
+    return chain, lps
+
+
+def summarize_chain(chain: np.ndarray, log_probs: np.ndarray, keys: list[str], burn: int = 0):
+    """Posterior summaries matching the reference's reporting
+    (fit_toas.py:192-202): median, 16/84-percentile deviations, MAP."""
+    flat = chain[burn:].reshape(-1, chain.shape[-1])
+    flat_lp = log_probs[burn:].reshape(-1)
+    i_map = int(np.argmax(flat_lp))
+    summaries = {}
+    for i, name in enumerate(keys):
+        q16, q50, q84 = np.percentile(flat[:, i], [16, 50, 84])
+        summaries[name] = {
+            "median": float(q50),
+            "minus": float(q50 - q16),
+            "plus": float(q84 - q50),
+            "map": float(flat[i_map, i]),
+        }
+    return flat, flat_lp, summaries

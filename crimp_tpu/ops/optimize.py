@@ -1,0 +1,133 @@
+"""Jittable, vmappable optimizers for the fitting engines.
+
+The reference leans on lmfit/scipy (Nelder-Mead, BFGS, brute) in serial
+Python loops; those cannot batch. These primitives are fixed-iteration,
+branch-free (where/cond-select) JAX implementations that vmap cleanly over
+ToA segments / MCMC walkers / Monte-Carlo draws:
+
+- ``golden_section``: 1-D bounded maximization (log-likelihood profiles);
+- ``nelder_mead``: fixed-iteration simplex minimization for the small
+  multi-parameter template/ToA fits;
+- ``bounded_transform``: lmfit-style min/max <-> unbounded reparameterization
+  so gradient methods respect box bounds.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+PHI = (jnp.sqrt(5.0) - 1) / 2  # golden ratio conjugate
+
+
+def golden_section(fn, lo, hi, iters: int = 60, maximize: bool = True):
+    """Golden-section search on [lo, hi]; returns (x_best, f_best).
+
+    ``fn`` maps a scalar (or batched scalar) to an objective value; lo/hi may
+    be arrays for batched independent searches.
+    """
+    sign = 1.0 if maximize else -1.0
+
+    def value(x):
+        return sign * fn(x)
+
+    def body(_, state):
+        a, b, x1, x2, f1, f2 = state
+        shrink_right = f1 > f2  # keep [a, x2]
+        new_a = jnp.where(shrink_right, a, x1)
+        new_b = jnp.where(shrink_right, x2, b)
+        new_x1 = new_b - PHI * (new_b - new_a)
+        new_x2 = new_a + PHI * (new_b - new_a)
+        return (new_a, new_b, new_x1, new_x2, value(new_x1), value(new_x2))
+
+    x1 = hi - PHI * (hi - lo)
+    x2 = lo + PHI * (hi - lo)
+    state = (lo, hi, x1, x2, value(x1), value(x2))
+    a, b, x1, x2, f1, f2 = jax.lax.fori_loop(0, iters, body, state)
+    x_best = jnp.where(f1 > f2, x1, x2)
+    return x_best, sign * jnp.maximum(f1, f2)
+
+
+@partial(jax.jit, static_argnames=("fn", "iters"))
+def nelder_mead(fn, x0: jax.Array, init_scale=0.1, iters: int = 200):
+    """Fixed-iteration Nelder-Mead minimization of ``fn`` from ``x0``.
+
+    Branch-free (select-based) so it vmaps; evaluates the standard
+    reflect/expand/contract candidates each step with a conditional shrink.
+    Returns (x_best, f_best).
+    """
+    n = x0.shape[-1]
+    simplex = jnp.concatenate(
+        [x0[None, :], x0[None, :] + jnp.eye(n, dtype=x0.dtype) * init_scale], axis=0
+    )
+    fvals = jax.vmap(fn)(simplex)
+
+    def step(state, _):
+        simplex, fvals = state
+        order = jnp.argsort(fvals)
+        simplex = simplex[order]
+        fvals = fvals[order]
+        best_f, worst_f, second_worst_f = fvals[0], fvals[-1], fvals[-2]
+        centroid = jnp.mean(simplex[:-1], axis=0)
+        direction = centroid - simplex[-1]
+
+        x_reflect = centroid + direction
+        x_expand = centroid + 2.0 * direction
+        x_out = centroid + 0.5 * direction
+        x_in = centroid - 0.5 * direction
+        f_reflect = fn(x_reflect)
+        f_expand = fn(x_expand)
+        f_out = fn(x_out)
+        f_in = fn(x_in)
+
+        # Candidate replacing the worst vertex (standard NM decision tree).
+        use_expand = (f_reflect < best_f) & (f_expand < f_reflect)
+        use_reflect = (~use_expand) & (f_reflect < second_worst_f)
+        use_out = (~use_expand) & (~use_reflect) & (f_reflect < worst_f) & (f_out <= f_reflect)
+        use_in = (~use_expand) & (~use_reflect) & (~use_out) & (f_in < worst_f)
+        shrink = ~(use_expand | use_reflect | use_out | use_in)
+
+        candidate = jnp.where(
+            use_expand[..., None],
+            x_expand,
+            jnp.where(
+                use_reflect[..., None],
+                x_reflect,
+                jnp.where(use_out[..., None], x_out, x_in),
+            ),
+        )
+        f_candidate = jnp.where(
+            use_expand,
+            f_expand,
+            jnp.where(use_reflect, f_reflect, jnp.where(use_out, f_out, f_in)),
+        )
+
+        replaced = simplex.at[-1].set(candidate)
+        replaced_f = fvals.at[-1].set(f_candidate)
+        shrunk = simplex[0][None, :] + 0.5 * (simplex - simplex[0][None, :])
+        shrunk_f = jax.vmap(fn)(shrunk)
+
+        new_simplex = jnp.where(shrink, shrunk, replaced)
+        new_f = jnp.where(shrink, shrunk_f, replaced_f)
+        return (new_simplex, new_f), None
+
+    (simplex, fvals), _ = jax.lax.scan(step, (simplex, fvals), None, length=iters)
+    i_best = jnp.argmin(fvals)
+    return simplex[i_best], fvals[i_best]
+
+
+class bounded_transform:
+    """lmfit-style box-bound reparameterization: x = lo + (hi-lo)*sigmoid(u)."""
+
+    def __init__(self, lo, hi):
+        self.lo = jnp.asarray(lo)
+        self.hi = jnp.asarray(hi)
+
+    def to_bounded(self, u):
+        return self.lo + (self.hi - self.lo) * jax.nn.sigmoid(u)
+
+    def to_unbounded(self, x):
+        frac = jnp.clip((x - self.lo) / (self.hi - self.lo), 1e-12, 1 - 1e-12)
+        return jnp.log(frac) - jnp.log1p(-frac)

@@ -1,0 +1,3 @@
+from crimp_tpu.parallel.mesh import build_mesh, z2_sharded, h_sharded
+
+__all__ = ["build_mesh", "z2_sharded", "h_sharded"]

@@ -1,0 +1,183 @@
+"""ToA time-interval builder (CLI: timeintervalsfortoas).
+
+Behavioral parity with the reference segmenter
+(buildtimeintervalsToAs.py:64-365): bunch GTIs at gaps larger than
+waitTimeCutoff, slice each bunch into ToAs of totCtsEachToA counts, clip
+GTIs to each ToA window for exact livetime, skip zero-exposure windows,
+merge trailing low-count intervals into their predecessor, and optionally
+correct NICER count rates for the number of selected FPMs (52-detector
+normalization, buildtimeintervalsToAs.py:287-290).
+
+This stage is data-dependent host logic by design (SURVEY.md §7.1 step 6
+boundary discipline): it stays numpy/pandas on CPU.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from crimp_tpu.io.events import EventFile
+from crimp_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+COLUMNS = ["ToA_tstart", "ToA_tend", "ToA_lenInt", "ToA_exposure", "Events", "ct_rate"]
+
+
+def _clipped_exposure_days(gti: np.ndarray, t_start: float, t_end: float) -> float:
+    """Livetime within [t_start, t_end]: GTIs clipped to the window."""
+    keep = (gti[:, 1] > t_start) & (gti[:, 0] < t_end)
+    if not keep.any():
+        return 0.0
+    clipped = gti[keep].copy()
+    # t_start/t_end are event times inside the first/last kept GTI, so the
+    # window edges replace those GTI edges outright (reference semantics,
+    # buildtimeintervalsToAs.py:239-242).
+    clipped[0, 0] = t_start
+    clipped[-1, -1] = t_end
+    return float(np.sum(clipped[:, 1] - clipped[:, 0]))
+
+
+def build_time_intervals(
+    evtFile: str,
+    totCtsEachToA: int = 1000,
+    waitTimeCutoff: float = 1.0,
+    eneLow: float = 0.5,
+    eneHigh: float = 10.0,
+    min_counts: int | None = None,
+    max_wait: float | None = None,
+    outputFile: str = "timIntToAs",
+    correxposure: bool = False,
+) -> pd.DataFrame:
+    """Build per-ToA [start, end] windows; writes <outputFile>.txt (+_bunches)."""
+    if min_counts is None:
+        min_counts = int(totCtsEachToA / 2)
+    if max_wait is None:
+        max_wait = waitTimeCutoff
+
+    logger.info(
+        "\n Running build_time_intervals: evtFile=%s totCtsEachToA=%s waitTimeCutoff=%s "
+        "eneLow=%s eneHigh=%s min_counts=%s max_wait=%s outputFile=%s",
+        evtFile, totCtsEachToA, waitTimeCutoff, eneLow, eneHigh, min_counts, max_wait, outputFile,
+    )
+
+    ef = EventFile(evtFile)
+    keywords, gti = ef.read_gti()
+    times = (
+        ef.build_time_energy_df().filtenergy(eneLow, eneHigh).time_energy_df["TIME"].to_numpy()
+    )
+
+    # --- bunch GTIs at gaps > waitTimeCutoff -------------------------------
+    gaps = gti[1:, 0] - gti[:-1, 1]
+    bunch_breaks = np.nonzero(gaps > waitTimeCutoff)[0] + 1
+    bunch_edges = np.concatenate([[0], bunch_breaks, [len(gti)]])
+
+    bunches = []
+    for lo, hi in zip(bunch_edges[:-1], bunch_edges[1:]):
+        seg = gti[lo:hi]
+        bunches.append(
+            (
+                seg[0, 0],
+                seg[-1, 1],
+                float(np.sum(seg[:, 1] - seg[:, 0])),
+                seg[-1, 1] - seg[0, 0],
+            )
+        )
+
+    with open(outputFile + "_bunches.txt", "w") as fh:
+        fh.write("ToABunch_tstart \t ToABunch_tend \t ToABunch_exp \t ToABunch_lenInt\n")
+        for start, end, exp_days, length in bunches:
+            fh.write(f"{start}\t{end}\t{exp_days * 86400}\t{length}\n")
+
+    # --- slice each bunch into count-limited ToA windows -------------------
+    rows = []
+    for start, end, _, _ in bunches:
+        in_bunch = times[(times >= start) & (times <= end)]
+        n_toas = int(np.ceil(len(in_bunch) / totCtsEachToA))
+        for k in range(n_toas):
+            chunk = in_bunch[k * totCtsEachToA : (k + 1) * totCtsEachToA] if k < n_toas - 1 else in_bunch[k * totCtsEachToA :]
+            if len(chunk) == 0:
+                continue
+            exposure_days = _clipped_exposure_days(gti, chunk[0], chunk[-1])
+            if exposure_days == 0:
+                logger.warning(
+                    "At %s MJD: exposure = 0 likely caused by a single timestamp in interval - skipping",
+                    chunk[0],
+                )
+                continue
+            exposure_sec = exposure_days * 86400.0
+            rows.append(
+                {
+                    "ToA_tstart": float(chunk[0]),
+                    "ToA_tend": float(chunk[-1]),
+                    "ToA_lenInt": float(chunk[-1] - chunk[0]),
+                    "ToA_exposure": exposure_sec,
+                    "Events": len(chunk),
+                    "ct_rate": len(chunk) / exposure_sec,
+                }
+            )
+
+    intervals = pd.DataFrame(rows, columns=COLUMNS)
+    intervals = merge_adjacent_intervals(intervals, min_counts, max_wait)
+    n_total = len(intervals)
+
+    # --- NICER FPM-selection exposure correction ---------------------------
+    if keywords["TELESCOPE"] == "NICER":
+        logger.warning(
+            "\n If NICER event files were generated with HEASOFT 6.32+, correct for "
+            "the number of selected FPMs (-ce) for accurate count rates\n"
+        )
+        if correxposure:
+            _, fpm = ef.read_fpmsel()
+            for i in range(n_total):
+                window = fpm.loc[
+                    (fpm["TIME"] >= intervals.at[i, "ToA_tstart"])
+                    & (fpm["TIME"] <= intervals.at[i, "ToA_tend"])
+                ]
+                n_selected = float(np.sum(window["TOTFPMSEL"]))
+                expected = 52.0 * intervals.at[i, "ToA_exposure"]
+                if n_selected > 0:
+                    intervals.at[i, "ct_rate"] *= expected / n_selected
+    elif keywords["TELESCOPE"] == "NuSTAR":
+        logger.warning(
+            "\n If NuSTAR event files merge FPMA and FPMB, count rates are a factor of 2 smaller.\n"
+        )
+
+    print(f"Total number of time intervals that define the TOAs: {n_total}")
+    intervals.to_csv(outputFile + ".txt", sep="\t", index=True, index_label="ToA")
+    logger.info(
+        "\n End of build_time_intervals run: %s intervals; wrote %s_bunches.txt and %s.txt",
+        n_total, outputFile, outputFile,
+    )
+    return intervals
+
+
+def merge_adjacent_intervals(df: pd.DataFrame, events_max: int, dtstart_max_days: float) -> pd.DataFrame:
+    """Merge a row into its predecessor when Events < events_max and the gap
+    to the previous interval end is < dtstart_max_days."""
+    if df.empty:
+        return pd.DataFrame(columns=COLUMNS)
+    merged = []
+    current = df.iloc[0].copy()
+    for i in range(1, len(df)):
+        row = df.iloc[i]
+        if row["Events"] < events_max and (row["ToA_tstart"] - current["ToA_tend"]) < dtstart_max_days:
+            current["ToA_tend"] = row["ToA_tend"]
+            current["ToA_lenInt"] = current["ToA_tend"] - current["ToA_tstart"]
+            current["ToA_exposure"] = current["ToA_exposure"] + row["ToA_exposure"]
+            current["Events"] = current["Events"] + row["Events"]
+            current["ct_rate"] = (
+                current["Events"] / current["ToA_exposure"]
+                if current["ToA_exposure"] != 0
+                else float("nan")
+            )
+        else:
+            merged.append(current[COLUMNS].copy())
+            current = row.copy()
+    merged.append(current[COLUMNS].copy())
+    return pd.DataFrame(merged, columns=COLUMNS).reset_index(drop=True)
+
+
+# Reference-named alias (buildtimeintervalsToAs.py:64).
+timeintervalsToAs = build_time_intervals

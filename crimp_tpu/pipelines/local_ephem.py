@@ -1,0 +1,180 @@
+"""Local [F0, F1] ephemerides in a sliding window (CLI: localephemerides).
+
+Workflow parity with the reference (get_local_ephem.py:27-265): slide a
+window (interval_days, jump_days) over the ToAs, truncating at glitch
+epochs and resuming after them; per window, build a minimal 14-key timing
+model anchored at the window-mid integer-rotation epoch (TRACK -2), fit
+F0/F1 with the ensemble MCMC under span-scaled box priors, record
+F0, F1 +/- err and chi2; finally detrend F0 by the global F0+F1 trend and
+write the CSV + plot.
+
+The MCMC is the pure-JAX sampler (ops.mcmc): each window's 1000-step,
+24-walker run is one device program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from crimp_tpu.io import parfile as parfile_io
+from crimp_tpu.io import tim as tim_io
+from crimp_tpu.io.yamlcfg import Prior
+from crimp_tpu.models import timing
+from crimp_tpu.ops.ephem import integer_rotation_host
+from crimp_tpu.pipelines import fit_utils
+from crimp_tpu.pipelines.fit_toas import load_toas_for_fit, plot_residuals, run_mcmc
+from crimp_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def generate_local_ephemerides(
+    tim_file: str,
+    parfile: str,
+    interval_days: float = 90.0,
+    jump_days: float = 15.0,
+    t_start: float | None = None,
+    t_end: float | None = None,
+    min_interval: float = 45.0,
+    debug_with_plots: bool = False,
+    outputfile: str | None = "local_ephemerides",
+    ephem_plot: str | None = None,
+    clobber: bool = False,
+    mcmc_steps: int = 1000,
+    mcmc_burn: int = 100,
+    mcmc_walkers: int = 24,
+) -> pd.DataFrame:
+    """Sliding-window local F0/F1; returns the detrended ephemerides table."""
+    logger.info(
+        "\n Running generate_local_ephemerides: tim_file=%s parfile=%s interval_days=%s "
+        "jump_days=%s t_start=%s t_end=%s min_interval=%s outputfile=%s",
+        tim_file, parfile, interval_days, jump_days, t_start, t_end, min_interval, outputfile,
+    )
+    par_values, _, _ = parfile_io.read_timing_model(parfile)
+    pepoch_global = par_values["PEPOCH"]
+    f0_global = par_values["F0"]
+    f1_global = par_values["F1"]
+    glitch_epochs = sorted(v for k, v in par_values.items() if k.startswith("GLEP_"))
+
+    toa_df = tim_io.read_tim(tim_file)
+    if t_start is None:
+        t_start = float(toa_df["pulse_ToA"].min())
+    if t_end is None:
+        t_end = float(toa_df["pulse_ToA"].max())
+
+    tm = timing.resolve(parfile)
+    current_start = t_start
+    records = []
+    eps = 1e-5
+    window_counter = 0
+
+    while current_start is not None and current_start < t_end:
+        valid = toa_df.loc[toa_df["pulse_ToA"] >= current_start, "pulse_ToA"]
+        current_start = float(valid.min()) if not valid.empty else None
+        if current_start is None:
+            break
+        current_end = min(current_start + interval_days, t_end)
+        window = toa_df.loc[
+            (toa_df["pulse_ToA"] >= current_start) & (toa_df["pulse_ToA"] <= current_end)
+        ]
+        if window.empty:
+            current_start += jump_days
+            continue
+        current_end = float(window["pulse_ToA"].max())
+
+        crossing_glitch = next(
+            (g for g in glitch_epochs if current_start < g < current_end), None
+        )
+        if crossing_glitch is not None:
+            window = window.loc[window["pulse_ToA"] <= crossing_glitch]
+            if window.empty:
+                current_start = crossing_glitch + eps
+                continue
+            current_end = float(window["pulse_ToA"].max())
+
+        mid = current_start + (current_end - current_start) / 2
+        span_days = current_end - current_start
+
+        if len(window) >= 4 and span_days > min_interval:
+            anchor = integer_rotation_host(tm, np.atleast_1d(mid))
+            mid_anchor = float(anchor["Tmjd_intRotation"][0])
+            f0_mid = float(anchor["freq_intRotation"][0])
+            f1_mid = float(anchor["freqdot_intRotation"][0])
+
+            # Minimal local model: PEPOCH at the anchor; F0, F1 free.
+            keys13 = ["PEPOCH"] + [f"F{i}" for i in range(13)]
+            values = [mid_anchor, f0_mid, f1_mid] + [0.0] * 11
+            flags = [0, 1, 1] + [0] * 11
+            local_par = {
+                k: {"value": np.float64(v), "flag": f}
+                for k, v, f in zip(keys13, values, flags)
+            }
+            local_par["TRACK"] = -2
+
+            fit_keys = fit_utils.list_fit_keys(local_par)
+            span_sec = span_days * 86400.0
+            prior = Prior(
+                bounds={
+                    "F0": (-100 / span_sec, 100 / span_sec),
+                    "F1": (-100 / span_sec**2, 100 / span_sec**2),
+                },
+                initial_guess={},
+            )
+            toas_to_fit = load_toas_for_fit(window, local_par)
+            _, _, summaries = run_mcmc(
+                toas_to_fit["ToA"], toas_to_fit["phase"], toas_to_fit["phase_err_cycle"],
+                local_par, fit_keys, prior,
+                steps=mcmc_steps, burn=mcmc_burn, walkers=mcmc_walkers,
+                corner_pdf=(f"corner_interval_{window_counter}" if debug_with_plots else None),
+                seed=window_counter,
+            )
+            med_vec = np.array([summaries[k]["median"] for k in fit_keys])
+            _, full_dict = fit_utils.inject_free_params(local_par, med_vec, fit_keys)
+            post_fit = fit_utils.model_phase_residuals(
+                toas_to_fit["ToA"].to_numpy(), local_par, med_vec, fit_keys
+            )
+            if debug_with_plots:
+                plot_residuals(toas_to_fit, post_fit, plotname=f"residuals_interval_{window_counter}")
+            window_counter += 1
+
+            stats = fit_utils.chi2_fit(
+                toas_to_fit["phase"], post_fit, toas_to_fit["phase_err_cycle"], 2
+            )
+            records.append(
+                {
+                    "TOA_MJD_ref": mid_anchor,
+                    "TOA_MJD_ref_err": span_days / 2.0,
+                    "F0": full_dict["F0"],
+                    "F0_err": max(summaries["F0"]["plus"], summaries["F0"]["minus"]),
+                    "F1": full_dict["F1"],
+                    "F1_err": max(summaries["F1"]["plus"], summaries["F1"]["minus"]),
+                    "CHI2R": stats["redchi2"],
+                    "DOF": stats["dof"],
+                }
+            )
+
+        if crossing_glitch is not None:
+            current_start = crossing_glitch + eps
+        else:
+            current_start += jump_days
+
+    if not records:
+        logger.warning(
+            "No interval made the criteria - decrease min_interval and/or increase "
+            "interval_days; returning empty dataframe"
+        )
+        return pd.DataFrame(records)
+
+    table = pd.DataFrame(records)
+    # Detrend F0 by the global linear trend (get_local_ephem.py:247-249).
+    trend = f0_global + f1_global * ((table["TOA_MJD_ref"] - pepoch_global) * 86400.0)
+    table["F0"] -= trend
+
+    if outputfile is not None:
+        table.to_csv(f"{outputfile}.txt", sep="\t", index=True, header=True, mode="w" if clobber else "x")
+    if ephem_plot is not None:
+        from crimp_tpu.pipelines.plot_local_ephem import plot_local_ephemerides
+
+        plot_local_ephemerides(table, glitch_epochs, ephem_plot)
+    return table

@@ -1,0 +1,265 @@
+"""Pulse ToA measurement pipeline (CLI: measuretoas) — the main product.
+
+Workflow parity with the reference engine (measureToAs.py:64-251): for each
+ToA interval from the interval file, select events, fold with the timing
+model, fit the template by unbinned extended maximum likelihood with the
+phase shift and normalization free, derive +/-1-sigma likelihood-profile
+uncertainties by 2*pi/phShiftRes stepping, compute the per-ToA H-test at
+the local ephemeris frequency and the binned-profile chi2, then write
+ToAs.txt, the optional .tim file, and the phase-residual plot.
+
+TPU re-design (SURVEY.md §2.4 "backends.xla.toafit"): the per-ToA loop is
+gone — every interval is anchored at its own epoch (ops.anchored keeps the
+fold under 1e-8 cycles), segments are padded/masked into one batch, and the
+entire run (global phase grid + golden refine + vectorized error scans +
+batched H-test + binned chi2) executes as a few jitted device programs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pandas as pd
+
+from crimp_tpu.io import template as template_io
+from crimp_tpu.io.events import EventFile
+from crimp_tpu.models import profiles, timing
+from crimp_tpu.ops import anchored, search, toafit
+from crimp_tpu.ops.ephem import spin_frequency_host
+from crimp_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+TOA_COLUMNS = [
+    "ToA", "ToA_mid", "ToA_start", "ToA_end", "ToA_lenInt", "ToA_exp",
+    "nbr_events", "count_rate", "phShift", "phShift_LL", "phShift_UL",
+    "Hpower", "redChi2",
+]
+
+
+def measure_toas(
+    evtFile: str,
+    timMod: str,
+    tempModPP: str,
+    toagtifile: str,
+    eneLow: float = 0.5,
+    eneHigh: float = 10.0,
+    toaStart: int = 0,
+    toaEnd: int | None = None,
+    phShiftRes: int = 1000,
+    nbrBins: int = 15,
+    varyAmps: bool = False,
+    readvaryparam: bool = False,
+    brutemin: bool = False,
+    plotPPs: bool = False,
+    plotLLs: bool = False,
+    toaFile: str = "ToAs",
+    timFile: str | None = None,
+) -> pd.DataFrame:
+    """Measure ToAs for every interval; returns the ToA table."""
+    logger.info(
+        "\n Running measure_toas: evtFile=%s timMod=%s tempModPP=%s toagtifile=%s "
+        "eneLow=%s eneHigh=%s toaStart=%s toaEnd=%s phShiftRes=%s nbrBins=%s "
+        "varyAmps=%s readvaryparam=%s brutemin=%s toaFile=%s timFile=%s",
+        evtFile, timMod, tempModPP, toagtifile, eneLow, eneHigh, toaStart, toaEnd,
+        phShiftRes, nbrBins, varyAmps, readvaryparam, brutemin, toaFile, timFile,
+    )
+    if readvaryparam or varyAmps:
+        raise NotImplementedError(
+            "readvaryparam / varyAmps (extra free parameters in the ToA fit) "
+            "land with the general Nelder-Mead ToA path; the default "
+            "fixed-shape path is available."
+        )
+
+    ef = EventFile(evtFile)
+    df = ef.build_time_energy_df().filtenergy(eneLow, eneHigh).time_energy_df
+    times_all = df["TIME"].to_numpy()
+
+    intervals = pd.read_csv(toagtifile, sep=r"\s+", comment="#")
+    if toaEnd is None:
+        toaEnd = len(intervals)
+    else:
+        toaEnd += 1  # inclusive, like the reference CLI
+    idx_range = range(toaStart, toaEnd)
+
+    tm = timing.resolve(timMod)
+    tpl_dict = template_io.read_template(tempModPP)
+    kind, tpl = profiles.from_template(tpl_dict)
+    logger.info("\n Using best fit model of template %s to measure ToAs", kind)
+
+    # ---- per-interval event selection + anchored fold --------------------
+    starts = intervals["ToA_tstart"].to_numpy()
+    ends = intervals["ToA_tend"].to_numpy()
+    exposures = intervals["ToA_exposure"].to_numpy()
+
+    toa_mids = np.zeros(len(idx_range))
+    seg_times: list[np.ndarray] = []
+    for out_i, ii in enumerate(idx_range):
+        sel = (times_all >= starts[ii]) & (times_all <= ends[ii])
+        t_seg = times_all[sel]
+        if t_seg.size == 0:
+            raise ValueError(f"ToA interval {ii} contains no events")
+        toa_mids[out_i] = (t_seg[-1] - t_seg[0]) / 2 + t_seg[0]
+        seg_times.append(t_seg)
+
+    # One anchor per ToA interval: the fold of every segment is exact.
+    # All segments fold in a SINGLE device call (concatenated deltas with a
+    # per-event anchor index) so the kernel compiles once regardless of the
+    # per-interval event-count raggedness.
+    import jax.numpy as jnp
+
+    am = anchored.prepare_anchors(tm, toa_mids)
+    seg_sizes = [t.size for t in seg_times]
+    anchor_idx = np.repeat(np.arange(len(seg_times)), seg_sizes)
+    delta_all = anchored.anchor_deltas(np.concatenate(seg_times), toa_mids, anchor_idx)
+    folded_all = np.asarray(
+        anchored.anchored_fold(am, jnp.asarray(delta_all), jnp.asarray(anchor_idx))
+    )
+    seg_phase_list = list(np.split(folded_all, np.cumsum(seg_sizes)[:-1]))
+
+    phases, masks = toafit.pad_segments(seg_phase_list)
+    if kind in (profiles.CAUCHY, profiles.VONMISES):
+        phases = phases * (2 * np.pi)  # radians convention (measureToAs.py:195-200)
+
+    cfg = toafit.ToAFitConfig(
+        kind=kind,
+        ph_shift_res=phShiftRes,
+        nbins=nbrBins,
+        vary_amps=varyAmps,
+    )
+    exp_batch = exposures[toaStart:toaEnd].astype(float)
+    results = toafit.fit_toas_batch(
+        kind, tpl, phases, masks, exp_batch, cfg
+    )
+    results = {k: np.asarray(v) for k, v in results.items()}
+
+    # ---- per-ToA H-test at the local ephemeris frequency -----------------
+    freqs_mid, _ = spin_frequency_host(tm, toa_mids)
+    sec_padded = np.zeros_like(phases)
+    sec_masks = np.zeros_like(masks)
+    for out_i, t_seg in enumerate(seg_times):
+        centered = (t_seg - (t_seg[0] + t_seg[-1]) / 2) * 86400.0
+        sec_padded[out_i, : t_seg.size] = centered
+        sec_masks[out_i, : t_seg.size] = True
+    h_powers = np.asarray(
+        search.h_power_segments(sec_padded, sec_masks, freqs_mid, nharm=5)
+    )
+
+    # ---- outputs ---------------------------------------------------------
+    with open(toaFile + ".txt", "w") as fh:
+        fh.write(
+            "ToA \t ToA_mid \t ToA_start \t ToA_end \t ToA_lenInt \t ToA_exp \t "
+            "nbr_events \t count_rate \t phShift \t phShift_LL \t phShift_UL \t "
+            "Hpower \t redChi2\n"
+        )
+        for out_i, ii in enumerate(idx_range):
+            print(f"ToA {ii}")
+            fh.write(
+                f"{ii}\t{toa_mids[out_i]}\t{starts[ii]}\t{ends[ii]}\t"
+                f"{intervals['ToA_lenInt'].iloc[ii]}\t{exposures[ii]}\t"
+                f"{intervals['Events'].iloc[ii]}\t{intervals['ct_rate'].iloc[ii]}\t"
+                f"{results['phShift'][out_i]}\t{results['phShift_LL'][out_i]}\t"
+                f"{results['phShift_UL'][out_i]}\t{h_powers[out_i]}\t"
+                f"{results['redChi2'][out_i]}\n"
+            )
+    logger.info("\n Wrote ToA properties to %s.txt", toaFile)
+
+    if plotLLs or plotPPs:
+        _diagnostic_plots(
+            kind, tpl, phases, masks, exp_batch, results, cfg, list(idx_range),
+            plotPPs=plotPPs, plotLLs=plotLLs,
+        )
+
+    if timFile is not None:
+        from crimp_tpu.pipelines.tim_tools import phshift_to_timfile
+
+        phshift_to_timfile(toaFile + ".txt", timMod, timFile, tempModPP=tempModPP)
+        logger.info("\n Wrote timfile %s.tim", timFile)
+
+    plot_phase_residuals(
+        toa_mids, results["phShift"], results["phShift_LL"], results["phShift_UL"],
+        outFile=toaFile,
+    )
+    logger.info("\n Created phase residual plot %s_phaseResiduals.pdf", toaFile)
+
+    return pd.read_csv(toaFile + ".txt", sep=r"\s+", comment="#")
+
+
+def _diagnostic_plots(kind, tpl, phases, masks, exposures, results, cfg, toa_ids, plotPPs, plotLLs):
+    """Optional per-ToA debug plots (profile + likelihood curve)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    import jax.numpy as jnp
+
+    from crimp_tpu.ops.binprofile import bin_phases
+    from crimp_tpu.ops.toafit import profile_loglik, shape_at_shifts
+
+    half = np.pi if kind == profiles.FOURIER else 1.5 * np.pi
+    for out_i, toa_id in enumerate(toa_ids):
+        x = phases[out_i][masks[out_i].astype(bool)]
+        exposure = exposures[out_i]
+        phi_best = results["phShift"][out_i]
+        if plotLLs:
+            span = 40 * (2 * np.pi / cfg.ph_shift_res)
+            phis = np.linspace(phi_best - span, phi_best + span, 161)
+            ll, _ = profile_loglik(kind, tpl, jnp.asarray(x), jnp.ones(len(x), bool), exposure, jnp.asarray(phis), cfg)
+            fig, ax = plt.subplots(figsize=(7, 5))
+            ax.plot(phis / (2 * np.pi), np.asarray(ll), "k.")
+            ax.set_xlabel("Phase (cycles)")
+            ax.set_ylabel("Log(L)")
+            fig.tight_layout()
+            fig.savefig(f"LogL_ToA{toa_id}.pdf", format="pdf")
+            plt.close(fig)
+        if plotPPs:
+            binned = bin_phases(x, cfg.nbins)
+            per_bin = exposure / cfg.nbins
+            rate = binned["ctsBins"] / per_bin
+            err = binned["ctsBinsErr"] / per_bin
+            centers = binned["ppBins"]
+            model_best = results["norm"][out_i] + np.asarray(
+                shape_at_shifts(kind, tpl, jnp.asarray(centers), jnp.asarray([phi_best]))
+            )[0]
+            model_init = results["norm"][out_i] + np.asarray(
+                shape_at_shifts(kind, tpl, jnp.asarray(centers), jnp.asarray([0.0]))
+            )[0]
+            cycle = 1.0 if kind == profiles.FOURIER else 2 * np.pi
+            c2 = np.concatenate([centers, centers + cycle])
+            fig, ax = plt.subplots(figsize=(7, 5))
+            ax.errorbar(c2, np.tile(rate, 2), yerr=np.tile(err, 2), fmt="ok", zorder=10)
+            ax.step(c2, np.tile(rate, 2), "k+-", where="mid", zorder=10)
+            ax.plot(c2, np.tile(model_init, 2), "g-", lw=2, label="Initial template")
+            ax.plot(c2, np.tile(model_best, 2), "r-", lw=2, label="After fitting for phase-shift")
+            ax.legend()
+            ax.set_xlabel("Phase (cycles)")
+            ax.set_ylabel("Normalized rate")
+            fig.tight_layout()
+            fig.savefig(f"pp_ToA{toa_id}.pdf", format="pdf")
+            plt.close(fig)
+
+
+def plot_phase_residuals(toa_mjds, ph_shifts, ph_lls, ph_uls, outFile: str = "") -> str:
+    """Phase residuals (cycles) vs MJD with asymmetric 1-sigma bars."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 5))
+    ax.errorbar(
+        toa_mjds,
+        np.asarray(ph_shifts) / (2 * np.pi),
+        yerr=(np.asarray(ph_lls) / (2 * np.pi), np.asarray(ph_uls) / (2 * np.pi)),
+        fmt="ok",
+    )
+    ax.set_xlabel("Time (MJD)")
+    ax.set_ylabel(r"$\Delta\phi$ (cycles)")
+    fig.tight_layout()
+    path = str(outFile) + "_phaseResiduals.pdf"
+    fig.savefig(path, format="pdf")
+    plt.close(fig)
+    return path
+
+
+# Reference-named alias (measureToAs.py:64).
+measureToAs = measure_toas

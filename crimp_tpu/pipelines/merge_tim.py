@@ -1,0 +1,129 @@
+"""Merge overlapping .tim files carrying pulse numbers (CLI: mergeoverlappingtims).
+
+Semantics parity with the reference (merge_overlapping_timfiles.py:109-214):
+consecutive files must share at least one ToA (matched after rounding MJDs
+to 12 decimals); the integer pulse-number shift is anchored on the FIRST
+overlap, every remaining overlap must then agree (hard error otherwise),
+and duplicated ToAs keep the earlier file's row.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pandas as pd
+
+from crimp_tpu.io.tim import PulseToAs, read_tim
+from crimp_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+TOA_ROUND_DECIMALS = 12  # fixed by design
+
+
+def _load_tim(timfile: str) -> pd.DataFrame:
+    df = read_tim(timfile, skiprows=1)
+    if "pulse_ToA" not in df.columns:
+        raise ValueError(f"{timfile}: missing required column 'pulse_ToA'")
+    if "pn" not in df.columns:
+        raise ValueError(
+            f"{timfile}: missing required pulse number column 'pn'. "
+            "Make sure every TOA line has '-pn <int>'."
+        )
+    df["pn"] = pd.to_numeric(df["pn"], errors="raise").astype(np.int64)
+    return df.sort_values("pulse_ToA").reset_index(drop=True)
+
+
+def expand_inputs(inputs: list[str]) -> list[str]:
+    """.tim paths, or .txt list files with one .tim per line, in order."""
+    timfiles: list[str] = []
+    for item in inputs:
+        path = Path(item)
+        if path.suffix.lower() == ".txt":
+            if not path.exists():
+                raise FileNotFoundError(f"List file not found: {item}")
+            for line in path.read_text().splitlines():
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    timfiles.append(line)
+        else:
+            timfiles.append(item)
+    if len(timfiles) < 2:
+        raise ValueError("Need at least two .tim files to merge.")
+    missing = [t for t in timfiles if not Path(t).exists()]
+    if missing:
+        raise FileNotFoundError("Missing .tim files:\n  " + "\n  ".join(missing))
+    return timfiles
+
+
+def _overlap_keys(a: pd.DataFrame, b: pd.DataFrame):
+    key_a = a["pulse_ToA"].round(TOA_ROUND_DECIMALS)
+    key_b = b["pulse_ToA"].round(TOA_ROUND_DECIMALS)
+    shared = pd.Index(key_a).intersection(pd.Index(key_b))
+    return key_a, key_b, shared
+
+
+def _merge_pair(merged: pd.DataFrame, nxt: pd.DataFrame) -> pd.DataFrame:
+    key_prev, key_next, shared = _overlap_keys(merged, nxt)
+    if shared.empty:
+        raise ValueError("No overlapping TOAs found between consecutive files.")
+
+    anchor = float(np.min(shared.to_numpy(dtype=float)))
+    shift = int(merged.loc[key_prev == anchor, "pn"].iloc[0]) - int(
+        nxt.loc[key_next == anchor, "pn"].iloc[0]
+    )
+    shifted = nxt.copy()
+    shifted["pn"] = (shifted["pn"] + shift).astype(np.int64)
+
+    # After shifting, EVERY overlapping ToA must agree on pn.
+    prev_map = (
+        merged.assign(_k=key_prev)
+        .loc[lambda d: d["_k"].isin(shared), ["_k", "pn"]]
+        .drop_duplicates("_k")
+        .set_index("_k")["pn"]
+    )
+    next_map = (
+        shifted.assign(_k=key_next)
+        .loc[lambda d: d["_k"].isin(shared), ["_k", "pn"]]
+        .drop_duplicates("_k")
+        .set_index("_k")["pn"]
+    )
+    joined = prev_map.to_frame("pn_prev").join(next_map.to_frame("pn_next"), how="inner")
+    mismatched = joined[joined["pn_prev"] != joined["pn_next"]]
+    if not mismatched.empty:
+        raise ValueError(
+            "Overlap validation failed: overlapping TOAs have inconsistent pulse "
+            f"numbers after shifting.\nFirst mismatches:\n{mismatched.head(10)}"
+        )
+
+    merged2 = merged.assign(_k=key_prev)
+    shifted = shifted.assign(_k=key_next)
+    out = (
+        pd.concat([merged2, shifted], ignore_index=True)
+        .sort_values("pulse_ToA")
+        .drop_duplicates(subset="_k", keep="first")
+        .drop(columns=["_k"])
+        .reset_index(drop=True)
+    )
+    logger.info("Applied shift %+d and merged (now %d TOAs).", shift, len(out))
+    return out
+
+
+def merge_tim_files(timfiles_or_listfiles: list[str]) -> pd.DataFrame:
+    """Merge a sequence of .tim files with consistent pulse numbering."""
+    timfiles = expand_inputs(timfiles_or_listfiles)
+    logger.info("Merging %d .tim files...", len(timfiles))
+    merged = _load_tim(timfiles[0])
+    for tf in timfiles[1:]:
+        merged = _merge_pair(merged, _load_tim(tf))
+    return merged
+
+
+def write_merged_tim(df: pd.DataFrame, outprefix: str, clobber: bool = False) -> None:
+    """Serialize the merged table through the FORMAT-1 writer, restoring the
+    -pn flag column layout."""
+    out = df.copy()
+    if "pn" in out.columns and "pn_flag" in out.columns:
+        out["pn_flag"] = "-pn"
+    PulseToAs(out).writetimfile(outprefix, clobber=clobber)

@@ -1,0 +1,53 @@
+"""Local-ephemerides table reading and plotting (CLI: localephemerides_plot).
+
+Parity with the reference (plot_local_ephem.py:10-107): read the table,
+optional time filter, then stacked F0/F1 panels vs MJD with x/y error bars
+and dashed glitch-epoch markers."""
+
+from __future__ import annotations
+
+import pandas as pd
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+
+def read_local_ephemerides(localephem: str, t_start: float | None = None, t_end: float | None = None) -> pd.DataFrame:
+    df = pd.read_csv(localephem, sep=r"\s+", comment="#", header=0)
+    if t_start is None:
+        t_start = df["TOA_MJD_ref"].min()
+    if t_end is None:
+        t_end = df["TOA_MJD_ref"].max()
+    mask = (df["TOA_MJD_ref"] >= t_start) & (df["TOA_MJD_ref"] <= t_end)
+    return df.loc[mask].reset_index(drop=True)
+
+
+def plot_local_ephemerides(local_df: pd.DataFrame, glitches=None, plotname=None):
+    """Stacked F0 / F1 error-bar panels with optional glitch markers."""
+    fig, axs = plt.subplots(2, 1, figsize=(10, 8), sharex=True)
+    for ax, f_col, err_col, label in (
+        (axs[0], "F0", "F0_err", "Frequency (Hz)"),
+        (axs[1], "F1", "F1_err", r"$\dot{F}$ (Hz s$^{-1}$)"),
+    ):
+        ax.errorbar(
+            local_df["TOA_MJD_ref"], local_df[f_col],
+            xerr=local_df["TOA_MJD_ref_err"], yerr=local_df[err_col],
+            fmt="o", color="k", ecolor="gray", elinewidth=1.5, capsize=2,
+            markersize=6, alpha=0.7,
+        )
+        ax.ticklabel_format(style="sci", axis="y", scilimits=(0, 0))
+        ax.set_ylabel(label)
+        ax.grid(True, linestyle="--", alpha=0.3)
+        if glitches:
+            for g in glitches:
+                ax.axvline(g, color="red", linestyle="--", linewidth=1.5, alpha=0.7)
+    axs[1].set_xlabel("Time (MJD)")
+    fig.tight_layout()
+    if plotname is None:
+        plt.close(fig)
+        return None
+    fig.savefig(str(plotname) + ".pdf", format="pdf", dpi=300, bbox_inches="tight")
+    plt.close(fig)
+    return str(plotname) + ".pdf"

@@ -1,0 +1,69 @@
+"""Synthetic modulated event-list generator (test fixture / fake backend).
+
+Behavioral parity with the reference simulator
+(simulatemodulatedlc.py:19-96): a sinusoidal profile sampled in phase bins,
+Poisson counts per bin, uniform rotation assignment, plus Poisson uniform
+background; returns event times with and without background. Also used by
+bench.py to build merged-dataset surrogates (the reference's large merged
+FITS file is absent from the snapshot)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def simulate_modulated_lc(
+    freq: float,
+    srcrate: float = 1.0,
+    exposure: float = 10000.0,
+    pulsedfraction: float = 0.2,
+    bgrrate: float = 0.05,
+    resolution: float = 0.073,
+    nbrPhaseBins: int | None = None,
+    rng: np.random.RandomState | None = None,
+) -> dict:
+    """Simulate a sinusoidally modulated light curve.
+
+    Returns {'assigned_t_wBgr', 'assigned_t_nobgr'}: sorted event times (s)
+    with and without background.
+    """
+    if rng is None:
+        rng = np.random.RandomState()
+
+    n_rotations = int(exposure * freq)
+    exposure_norm = n_rotations / freq
+
+    amp = np.sqrt(2) * pulsedfraction * srcrate
+    if amp > srcrate:
+        raise ValueError("RMS pulsed fraction cannot be larger than 1/sqrt(2)")
+
+    if nbrPhaseBins is None:
+        nbrPhaseBins = int(np.floor(1 / (resolution * freq)))
+    if nbrPhaseBins < 4:
+        raise ValueError(
+            "nbrPhaseBins is very small; increase time resolution or set it manually"
+        )
+
+    bin_phases = np.linspace(0, 1, nbrPhaseBins, endpoint=False)
+    # peak mid-cycle (cos shifted by pi), counts per phase bin over the run
+    expected = (srcrate + amp * np.cos(2 * np.pi * bin_phases + np.pi)) * (
+        exposure_norm / nbrPhaseBins
+    )
+
+    chunks = []
+    for k in range(nbrPhaseBins):
+        n_events = rng.poisson(expected[k])
+        rotation = rng.uniform(0, n_rotations, n_events).astype(int)
+        within = rng.uniform(bin_phases[k], bin_phases[k] + 1 / nbrPhaseBins, n_events)
+        chunks.append(rotation + within)
+    phases = np.sort(np.concatenate(chunks)) if chunks else np.zeros(0)
+
+    t_nobgr = np.sort(phases / freq)
+    n_bkg = rng.poisson(bgrrate * exposure_norm)
+    t_bkg = np.sort(rng.uniform(0, exposure_norm, n_bkg))
+    t_wbgr = np.sort(np.concatenate([t_nobgr, t_bkg]))
+    return {"assigned_t_wBgr": t_wbgr, "assigned_t_nobgr": t_nobgr}
+
+
+# Reference-named alias (simulatemodulatedlc.py:19).
+simulatemodulatedlc = simulate_modulated_lc

@@ -1,0 +1,3 @@
+from crimp_tpu.utils.logging import configure_logging, get_logger
+
+__all__ = ["configure_logging", "get_logger"]

@@ -1,0 +1,313 @@
+// crimpio — native event-file I/O runtime for crimp_tpu.
+//
+// The hot host-side path of the framework is pulling event columns (TIME,
+// PI) out of multi-gigabyte FITS binary tables and pre-binning phases
+// before anything reaches the TPU. The pure-Python FITS layer
+// (crimp_tpu/io/fitsio.py) is the reference implementation; this library
+// is the production path for large merged files (1e7-1e8 events,
+// BASELINE.json configs 3/5): mmap the file, walk the 2880-byte header
+// blocks once, and decode big-endian columns straight into caller-owned
+// f64 buffers.
+//
+// Exposed as a plain C ABI consumed via ctypes (the image has no
+// pybind11). All functions return 0 on success, negative error codes
+// otherwise.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <cmath>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr long kBlock = 2880;
+constexpr long kCard = 80;
+constexpr int kMaxCols = 64;
+constexpr int kMaxHdus = 64;
+
+struct Column {
+  char name[72];
+  char code;      // FITS TFORM letter
+  int repeat;     // element count (bits for X)
+  long offset;    // byte offset within a row
+  long width;     // byte width within a row
+  double tscal;   // TSCALn (1.0 when absent)
+  double tzero;   // TZEROn (0.0 when absent)
+};
+
+struct Hdu {
+  char extname[72];
+  long data_offset;  // absolute byte offset of the data block
+  long row_bytes;    // NAXIS1
+  long n_rows;       // NAXIS2
+  int n_cols;
+  Column cols[kMaxCols];
+};
+
+struct CioFile {
+  int fd;
+  const uint8_t* map;
+  long size;
+  int n_hdus;
+  Hdu hdus[kMaxHdus];
+};
+
+long type_width(char code, int repeat) {
+  switch (code) {
+    case 'L': case 'B': case 'A': return repeat;
+    case 'X': return (repeat + 7) / 8;
+    case 'I': return 2L * repeat;
+    case 'J': case 'E': return 4L * repeat;
+    case 'K': case 'D': case 'C': return 8L * repeat;
+    case 'M': return 16L * repeat;
+    default: return -1;
+  }
+}
+
+// Parse "KEY     = value" cards we care about. Returns value start or null.
+const char* card_value(const char* card, const char* key) {
+  size_t klen = strlen(key);
+  if (strncmp(card, key, klen) != 0) return nullptr;
+  for (size_t i = klen; i < 8; ++i)
+    if (card[i] != ' ') return nullptr;
+  if (card[8] != '=' || card[9] != ' ') return nullptr;
+  return card + 10;
+}
+
+long parse_long(const char* value) { return strtol(value, nullptr, 10); }
+
+void parse_string(const char* value, char* out, size_t out_len) {
+  // FITS string: 'text' possibly padded; copy between quotes, rstrip.
+  const char* p = value;
+  while (*p == ' ') ++p;
+  size_t n = 0;
+  if (*p == '\'') {
+    ++p;
+    while (*p && *p != '\'' && n + 1 < out_len) out[n++] = *p++;
+  }
+  while (n > 0 && out[n - 1] == ' ') --n;
+  out[n] = '\0';
+}
+
+}  // namespace
+
+extern "C" {
+
+int cio_open(const char* path, CioFile** out) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { close(fd); return -1; }
+  const uint8_t* map =
+      static_cast<const uint8_t*>(mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0));
+  if (map == MAP_FAILED) { close(fd); return -2; }
+
+  CioFile* f = new CioFile();
+  f->fd = fd;
+  f->map = map;
+  f->size = st.st_size;
+  f->n_hdus = 0;
+
+  long pos = 0;
+  while (pos + kBlock <= f->size && f->n_hdus < kMaxHdus) {
+    Hdu& hdu = f->hdus[f->n_hdus];
+    memset(&hdu, 0, sizeof(Hdu));
+    long naxis = 0, naxis1 = 0, naxis2 = 0, pcount = 0, bitpix = 8, tfields = 0;
+    char tform[kMaxCols][16];
+    memset(tform, 0, sizeof(tform));
+    double tscal[kMaxCols], tzero[kMaxCols];
+    for (int i = 0; i < kMaxCols; ++i) { tscal[i] = 1.0; tzero[i] = 0.0; }
+    bool end_seen = false;
+    while (!end_seen) {
+      if (pos + kBlock > f->size) { delete f; return -3; }
+      for (long c = 0; c < kBlock; c += kCard) {
+        const char* card = reinterpret_cast<const char*>(f->map + pos + c);
+        if (strncmp(card, "END", 3) == 0 && (card[3] == ' ' || card[3] == '\0')) {
+          end_seen = true;
+          break;
+        }
+        const char* value;
+        if ((value = card_value(card, "NAXIS"))) naxis = parse_long(value);
+        else if ((value = card_value(card, "NAXIS1"))) naxis1 = parse_long(value);
+        else if ((value = card_value(card, "NAXIS2"))) naxis2 = parse_long(value);
+        else if ((value = card_value(card, "PCOUNT"))) pcount = parse_long(value);
+        else if ((value = card_value(card, "BITPIX"))) bitpix = labs(parse_long(value));
+        else if ((value = card_value(card, "TFIELDS"))) tfields = parse_long(value);
+        else if ((value = card_value(card, "EXTNAME"))) parse_string(value, hdu.extname, sizeof(hdu.extname));
+        else if (strncmp(card, "TTYPE", 5) == 0 || strncmp(card, "TFORM", 5) == 0 ||
+                 strncmp(card, "TSCAL", 5) == 0 || strncmp(card, "TZERO", 5) == 0) {
+          char* endp;
+          int idx = static_cast<int>(strtol(card + 5, &endp, 10));
+          if (idx >= 1 && idx <= kMaxCols && endp && *endp == ' ') {
+            const char* v = card + 10;
+            if (strncmp(card, "TTYPE", 5) == 0)
+              parse_string(v, hdu.cols[idx - 1].name, sizeof(hdu.cols[idx - 1].name));
+            else if (strncmp(card, "TFORM", 5) == 0)
+              parse_string(v, tform[idx - 1], sizeof(tform[idx - 1]));
+            else if (strncmp(card, "TSCAL", 5) == 0)
+              tscal[idx - 1] = strtod(v, nullptr);
+            else
+              tzero[idx - 1] = strtod(v, nullptr);
+          }
+        }
+      }
+      pos += kBlock;
+    }
+    hdu.row_bytes = naxis1;
+    hdu.n_rows = naxis2;
+    hdu.n_cols = static_cast<int>(tfields < kMaxCols ? tfields : kMaxCols);
+    long offset = 0;
+    for (int i = 0; i < hdu.n_cols; ++i) {
+      const char* form = tform[i];
+      int repeat = 0;
+      while (*form >= '0' && *form <= '9') { repeat = repeat * 10 + (*form - '0'); ++form; }
+      if (repeat == 0) repeat = 1;
+      hdu.cols[i].code = *form;
+      hdu.cols[i].repeat = repeat;
+      hdu.cols[i].offset = offset;
+      hdu.cols[i].width = type_width(*form, repeat);
+      hdu.cols[i].tscal = tscal[i];
+      hdu.cols[i].tzero = tzero[i];
+      if (hdu.cols[i].width < 0) { delete f; return -4; }
+      offset += hdu.cols[i].width;
+    }
+    hdu.data_offset = pos;
+    long data_bytes = 0;
+    if (naxis > 0) data_bytes = (bitpix / 8) * naxis1 * (naxis2 > 0 ? naxis2 : 1) + pcount;
+    pos += (data_bytes + kBlock - 1) / kBlock * kBlock;
+    ++f->n_hdus;
+  }
+  *out = f;
+  return 0;
+}
+
+void cio_close(CioFile* f) {
+  if (!f) return;
+  munmap(const_cast<uint8_t*>(f->map), f->size);
+  close(f->fd);
+  delete f;
+}
+
+int cio_find_hdu(CioFile* f, const char* extname) {
+  for (int i = 0; i < f->n_hdus; ++i)
+    if (strcmp(f->hdus[i].extname, extname) == 0) return i;
+  return -1;
+}
+
+long cio_n_rows(CioFile* f, int hdu) {
+  if (hdu < 0 || hdu >= f->n_hdus) return -1;
+  return f->hdus[hdu].n_rows;
+}
+
+// Decode one scalar column into f64 (big-endian source), full length.
+int cio_read_column_f64(CioFile* f, int hdu_idx, const char* column, double* out) {
+  if (hdu_idx < 0 || hdu_idx >= f->n_hdus) return -1;
+  const Hdu& hdu = f->hdus[hdu_idx];
+  const Column* col = nullptr;
+  for (int i = 0; i < hdu.n_cols; ++i)
+    if (strcmp(hdu.cols[i].name, column) == 0) { col = &hdu.cols[i]; break; }
+  if (!col) return -2;
+  if (col->repeat != 1) return -3;
+
+  const uint8_t* base = f->map + hdu.data_offset + col->offset;
+  const long stride = hdu.row_bytes;
+  const long n = hdu.n_rows;
+
+  switch (col->code) {
+    case 'D':
+      for (long i = 0; i < n; ++i) {
+        uint64_t raw;
+        memcpy(&raw, base + i * stride, 8);
+        raw = __builtin_bswap64(raw);
+        double value;
+        memcpy(&value, &raw, 8);
+        out[i] = value;
+      }
+      break;
+    case 'E':
+      for (long i = 0; i < n; ++i) {
+        uint32_t raw;
+        memcpy(&raw, base + i * stride, 4);
+        raw = __builtin_bswap32(raw);
+        float value;
+        memcpy(&value, &raw, 4);
+        out[i] = static_cast<double>(value);
+      }
+      break;
+    case 'I':
+      for (long i = 0; i < n; ++i) {
+        uint16_t raw;
+        memcpy(&raw, base + i * stride, 2);
+        raw = __builtin_bswap16(raw);
+        out[i] = static_cast<double>(static_cast<int16_t>(raw));
+      }
+      break;
+    case 'J':
+      for (long i = 0; i < n; ++i) {
+        uint32_t raw;
+        memcpy(&raw, base + i * stride, 4);
+        raw = __builtin_bswap32(raw);
+        out[i] = static_cast<double>(static_cast<int32_t>(raw));
+      }
+      break;
+    case 'K':
+      for (long i = 0; i < n; ++i) {
+        uint64_t raw;
+        memcpy(&raw, base + i * stride, 8);
+        raw = __builtin_bswap64(raw);
+        out[i] = static_cast<double>(static_cast<int64_t>(raw));
+      }
+      break;
+    case 'B':
+      for (long i = 0; i < n; ++i) out[i] = static_cast<double>(base[i * stride]);
+      break;
+    default:
+      return -4;
+  }
+  // TSCAL/TZERO (e.g. the unsigned-int TZERO=32768 convention) — matches
+  // the pure-Python reader's _decode_column.
+  if (col->tscal != 1.0 || col->tzero != 0.0) {
+    for (long i = 0; i < n; ++i) out[i] = out[i] * col->tscal + col->tzero;
+  }
+  return 0;
+}
+
+// Fused selection: keep events with lo <= energy <= hi (after the caller's
+// affine PI->keV map applied here: kev = pi * scale + offset), writing
+// selected times and energies compactly; returns the kept count.
+long cio_filter_energy(const double* time, const double* pi, long n,
+                       double scale, double offset, double lo, double hi,
+                       double* time_out, double* kev_out) {
+  long kept = 0;
+  for (long i = 0; i < n; ++i) {
+    const double kev = pi[i] * scale + offset;
+    if (kev >= lo && kev <= hi) {
+      time_out[kept] = time[i];
+      kev_out[kept] = kev;
+      ++kept;
+    }
+  }
+  return kept;
+}
+
+// Phase histogram: counts of phases in [0, upper) over nbins uniform bins.
+int cio_phase_histogram(const double* phases, long n, double upper, long nbins,
+                        int64_t* counts) {
+  memset(counts, 0, sizeof(int64_t) * nbins);
+  const double scale = nbins / upper;
+  for (long i = 0; i < n; ++i) {
+    long b = static_cast<long>(phases[i] * scale);
+    if (b < 0) b = 0;
+    if (b >= nbins) b = nbins - 1;
+    ++counts[b];
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,143 @@
+"""Fold & ephemeris kernels vs an independent straight-formula oracle.
+
+The <1 µs ToA budget corresponds to ~1.4e-7 cycles at F0=0.143 Hz
+(BASELINE.md north-star); the anchored fold is asserted an order tighter.
+"""
+
+import numpy as np
+import pytest
+
+from crimp_tpu.io import parfile
+from crimp_tpu.models import timing
+from crimp_tpu.ops import anchored, ephem, fold
+
+from conftest import PAR, reference_fold
+
+BUDGET_CYCLES = 1.4e-7  # 1 us at F0 = 0.1433 Hz
+
+
+def wrap_diff(a, b):
+    d = np.abs(np.asarray(a) - np.asarray(b))
+    return np.minimum(d, 1 - d)
+
+
+@pytest.fixture(scope="module")
+def glitchy_params():
+    params = {
+        "PEPOCH": 58359.55765869704,
+        "F0": 0.14328254547263483,
+        "F1": -9.746993965547238e-15,
+        "F2": 1.3624129994547033e-23,
+        "GLEP_1": 58400.0,
+        "GLPH_1": 0.1,
+        "GLF0_1": 1e-7,
+        "GLF1_1": -1e-14,
+        "GLF2_1": 0.0,
+        "GLF0D_1": 2e-7,
+        "GLTD_1": 40.0,
+        "GLEP_2": 58600.0,
+        "GLPH_2": -0.05,
+        "GLF0_2": 5e-8,
+        "GLF1_2": 0.0,
+        "GLF2_2": 0.0,
+        "GLF0D_2": 0.0,
+        "GLTD_2": 1.0,
+        "WAVEEPOCH": 58359.5,
+        "WAVE_OM": 0.01,
+        "WAVE1": {"A": 0.02, "B": -0.01},
+        "WAVE2": {"A": 0.005, "B": 0.003},
+        "WAVE3": {"A": -0.002, "B": 0.001},
+    }
+    for i in range(3, 13):
+        params[f"F{i}"] = 0.0
+    return params
+
+
+class TestFold:
+    def test_bundled_par_against_oracle(self, event_times):
+        values, _, _ = parfile.read_timing_model(PAR)
+        oracle = reference_fold(event_times, values)
+        total, folded = fold.fold_phases(event_times, PAR)
+        assert np.abs(total - oracle.astype(np.float64)).max() < 1e-8
+        oracle_fold = (oracle - np.floor(oracle)).astype(np.float64)
+        assert wrap_diff(folded, oracle_fold).max() < BUDGET_CYCLES / 10
+
+    def test_glitches_and_waves(self, glitchy_params):
+        rng = np.random.RandomState(2)
+        t = np.sort(rng.uniform(58135, 58737, 20000))
+        oracle = reference_fold(t, glitchy_params)
+        total, folded = fold.fold_phases(t, glitchy_params)
+        assert np.abs(total - oracle.astype(np.float64)).max() < 1e-7
+        oracle_fold = (oracle - np.floor(oracle)).astype(np.float64)
+        assert wrap_diff(folded, oracle_fold).max() < BUDGET_CYCLES
+
+    def test_scalar_in_scalar_out(self):
+        total, folded = fold.fold_phases(58136.13, PAR)
+        assert np.isscalar(total) and np.isscalar(folded)
+        assert 0 <= folded < 1
+
+    def test_absolute_device_kernel_matches_at_search_precision(self, event_times):
+        """The absolute (non-anchored) kernel is search-grade: ~1e-6 cycles."""
+        tm = timing.from_par(PAR)
+        import jax.numpy as jnp
+
+        _, folded_dev = fold.fold(tm, jnp.asarray(event_times))
+        folded_exact = anchored.fold_chunked(event_times, tm)
+        assert wrap_diff(np.asarray(folded_dev), folded_exact).max() < 5e-5
+
+    def test_anchored_chunking_invariance(self, event_times):
+        """Chunk size must not matter (anchors are exact by construction)."""
+        tm = timing.from_par(PAR)
+        f1 = anchored.fold_chunked(event_times, tm, chunk_days=30.0)
+        f2 = anchored.fold_chunked(event_times, tm, chunk_days=0.5)
+        assert wrap_diff(f1, f2).max() < BUDGET_CYCLES / 5
+
+
+class TestEphem:
+    def test_frequency_at_pepoch(self):
+        values, _, _ = parfile.read_timing_model(PAR)
+        out = ephem.ephem_at(values["PEPOCH"], PAR)
+        assert out["freqAtTmjd"] == pytest.approx(values["F0"], abs=1e-15)
+        assert out["freqdotAtTmjd"] == pytest.approx(values["F1"], abs=1e-22)
+
+    def test_frequency_derivative_consistency(self):
+        # numeric derivative of freq(t) should match freqdot
+        t = 58300.0
+        eps = 0.5  # days
+        f_hi = ephem.ephem_at(t + eps, PAR)["freqAtTmjd"]
+        f_lo = ephem.ephem_at(t - eps, PAR)["freqAtTmjd"]
+        fdot = ephem.ephem_at(t, PAR)["freqdotAtTmjd"]
+        assert (f_hi - f_lo) / (2 * eps * 86400) == pytest.approx(fdot, rel=1e-6)
+
+    def test_integer_rotation(self):
+        out = ephem.ephem_integer_rotation(58136.13012675689, PAR)
+        # The residual floor is set by f64 time quantization: one ulp of MJD
+        # (~7.3e-12 d = 0.63 us) maps to ~9e-8 cycles at F0; the Newton solve
+        # must land within that floor (same floor as the reference solver).
+        assert abs(out["phase_residual_from_integer"]) < 1.5e-7
+        # anchor is at most one rotation before the input epoch
+        assert 0 <= 58136.13012675689 - out["Tmjd_intRotation"] < 1.2 / out["freq_intRotation"] / 86400
+
+    def test_integer_rotation_batch(self):
+        t = np.array([58136.13, 58200.0, 58700.0])
+        out = ephem.ephem_integer_rotation(t, PAR)
+        assert out["Tmjd_intRotation"].shape == (3,)
+        assert np.abs(out["phase_residual_from_integer"]).max() < 1.5e-7
+
+    def test_glitch_frequency_step(self, glitchy_params=None):
+        params = {
+            "PEPOCH": 58000.0,
+            "F0": 0.5,
+            "GLEP_1": 58100.0,
+            "GLF0_1": 1e-6,
+            "GLPH_1": 0.0,
+            "GLF1_1": 0.0,
+            "GLF2_1": 0.0,
+            "GLF0D_1": 0.0,
+            "GLTD_1": 1.0,
+        }
+        for i in range(1, 13):
+            params[f"F{i}"] = 0.0
+        before = ephem.ephem_at(58099.9, params)["freqAtTmjd"]
+        after = ephem.ephem_at(58100.1, params)["freqAtTmjd"]
+        assert after - before == pytest.approx(1e-6, rel=1e-9)

@@ -1,0 +1,200 @@
+"""Host I/O layer: .par, template .txt, .tim, FITS round-trips and oracles."""
+
+import numpy as np
+import pytest
+
+from crimp_tpu.io import parfile, template, tim, fitsio
+from crimp_tpu.io.events import EventFile
+
+from conftest import PAR, TEMPLATE, FITS, TOAS_TIM
+
+
+class TestParFile:
+    def test_taylor_values(self):
+        values, flags, both = parfile.read_timing_model(PAR)
+        assert values["PEPOCH"] == 58359.55765869704
+        assert values["F0"] == 0.14328254547263483
+        assert values["F1"] == -9.746993965547238e-15
+        assert values["F2"] == 1.3624129994547033e-23
+        assert values["F3"] == 0.0 and values["F12"] == 0.0
+        assert both["F0"] == {"value": values["F0"], "flag": 0}
+
+    def test_miscellaneous(self):
+        misc = parfile.read_miscellaneous(PAR)
+        assert misc["PSR"] == "J2259+586"
+        assert misc["EPHEM"] == "DE405"
+        assert misc["START"] == 58135.0
+        assert misc["FINISH"] == 58737.0
+
+    def test_glitches_and_waves(self, tmp_path):
+        par = tmp_path / "glitchy.par"
+        par.write_text(
+            "PEPOCH 58000\nF0 0.5 1\nF1 -1e-13 1\n"
+            "GLEP_1 58100\nGLF0_1 1e-7 1\nGLPH_1 0.1\n"
+            "WAVEEPOCH 58000\nWAVE_OM 0.02 1\nWAVE1 0.1 -0.2\nWAVE2 0.05 0.02\n"
+            "TRACK -2\n"
+        )
+        values, flags, both = parfile.read_timing_model(str(par))
+        assert values["GLEP_1"] == 58100
+        assert values["GLF0_1"] == 1e-7 and flags["GLF0_1"] == 1
+        assert values["GLTD_1"] == 1.0  # default avoids division by zero
+        assert values["WAVE1"] == {"A": 0.1, "B": -0.2}
+        assert flags["WAVE_OM"] == 1
+        assert values["TRACK"] == -2
+        assert flags["F0"] == 1 and flags["PEPOCH"] == 0
+
+    def test_patch_values_preserves_format(self, tmp_path):
+        out = tmp_path / "patched.par"
+        parfile.patch_par_values(
+            PAR, str(out), new_values={"F0": 0.1444, "F1": -9.5e-15}
+        )
+        values, _, _ = parfile.read_timing_model(str(out))
+        assert values["F0"] == 0.1444
+        assert values["F1"] == -9.5e-15
+        # untouched lines identical
+        orig = open(PAR).read().splitlines()
+        new = out.read_text().splitlines()
+        for o, n in zip(orig, new):
+            if not o.startswith(("F0", "F1")):
+                assert o == n
+
+    def test_patch_values_with_flags_and_uncertainties(self, tmp_path):
+        par = tmp_path / "in.par"
+        par.write_text("PEPOCH 58000\nF0 0.5 1 1e-9\nF1 -1e-13 1\n")
+        out = tmp_path / "out.par"
+        parfile.patch_par_values(
+            str(par),
+            str(out),
+            new_values={"F0": 0.6, "F1": -2e-13},
+            uncertainties={"F0": 2e-9, "F1": 3e-16},
+        )
+        text = out.read_text()
+        assert "0.6 1 2e-09" in text
+        assert "-2e-13 1 3e-16" in text
+
+    def test_patch_statistics_appends(self, tmp_path):
+        out = tmp_path / "stats.par"
+        parfile.patch_statistics(PAR, str(out), {"CHI2R": 1.5, "CHI2R_DOF": 80, "NTOA": 84, "TRES": 120.5})
+        stats = parfile.read_statistics(str(out))
+        assert stats == {"CHI2R": 1.5, "CHI2R_DOF": 80, "NTOA": 84, "TRES": 120.5}
+
+    def test_patch_miscellaneous(self, tmp_path):
+        out = tmp_path / "misc.par"
+        parfile.patch_miscellaneous(PAR, str(out), {"START": 58200.0, "TRACK": -2})
+        misc = parfile.read_miscellaneous(str(out))
+        assert misc["START"] == 58200.0
+        assert misc["TRACK"] == -2
+
+
+class TestTemplate:
+    def test_read_oracle(self):
+        t = template.read_template(TEMPLATE)
+        assert t["model"] == "fourier"
+        assert t["nbrComp"] == 6
+        assert t["norm"]["value"] == pytest.approx(17.060771467236613)
+        assert t["amp_2"]["value"] == pytest.approx(4.055594828231136)
+        assert t["ph_6"]["value"] == pytest.approx(0.8297144204463391)
+        assert t["norm"]["vary"] is True
+        # committed best-fit statistics (BASELINE oracle)
+        assert t["chi2"] == pytest.approx(57.248608783903634)
+        assert t["dof"] == 57
+        assert t["redchi2"] == pytest.approx(1.0043615576123444)
+
+    def test_write_read_roundtrip(self, tmp_path):
+        fit = {
+            "model": "vonmises",
+            "norm": 3.25,
+            "amp_1": 1.5,
+            "cen_1": 2.0,
+            "wid_1": 0.3,
+            "amp_2": 0.7,
+            "cen_2": 4.0,
+            "wid_2": 0.5,
+            "chi2": 10.0,
+            "dof": 9,
+            "redchi2": 10 / 9,
+        }
+        path = template.write_template(str(tmp_path / "tpl"), fit)
+        back = template.read_template(path)
+        assert back["model"] == "vonmises"
+        assert back["nbrComp"] == 2
+        assert back["wid_2"]["value"] == pytest.approx(0.5)
+
+    def test_errors(self, tmp_path):
+        bad = tmp_path / "bad.txt"
+        bad.write_text("norm 1 vary True\n")
+        with pytest.raises(ValueError):
+            template.read_template(str(bad))
+
+
+class TestTim:
+    def test_read_oracle(self):
+        df = tim.read_tim(TOAS_TIM)
+        assert len(df) == 84
+        assert df["pulse_ToA"].iloc[0] == pytest.approx(58136.13012457407, abs=1e-11)
+        assert df["pulse_ToA_err"].iloc[0] == pytest.approx(45364.85116)
+        assert df["i"].iloc[0] == "Xray"
+
+    def test_write_roundtrip(self, tmp_path):
+        df = tim.read_tim(TOAS_TIM)
+        stem = str(tmp_path / "out")
+        tim.write_tim(stem, df)
+        back = tim.read_tim(stem + ".tim")
+        np.testing.assert_allclose(
+            back["pulse_ToA"].to_numpy(), df["pulse_ToA"].to_numpy(), atol=1e-12
+        )
+        first = open(stem + ".tim").readline()
+        assert first == "FORMAT 1\n"
+
+    def test_time_filter(self):
+        df = tim.read_tim(TOAS_TIM)
+        pt = tim.PulseToAs(df)
+        pt.time_filter(58140.0, 58200.0)
+        assert pt.df["pulse_ToA"].between(58140, 58200).all()
+        pt.reset()
+        assert len(pt.df) == 84
+
+
+class TestFits:
+    def test_read_structure(self):
+        f = fitsio.read_fits(FITS)
+        events = f["EVENTS"]
+        assert int(events.header["NAXIS2"]) == 89465
+        assert len(events.column("TIME")) == 89465
+        gti = f["GTI"]
+        assert len(gti.column("START")) == 35
+
+    def test_event_file_ops(self):
+        ef = EventFile(FITS)
+        kw, gti = ef.read_gti()
+        assert kw["TELESCOPE"] == "NICER"
+        assert gti.shape == (35, 2)
+        assert (gti[:, 1] > gti[:, 0]).all()
+        # MJDs in a sane NICER range
+        assert 58000 < gti.min() < 58200
+        df = ef.build_time_energy_df().filtenergy(1.0, 5.0).time_energy_df
+        assert len(df) == 68877  # 1-5 keV filtered count from EVENTS PI
+        assert df["PI"].between(1.0, 5.0).all()
+
+    def test_filttime(self):
+        ef = EventFile(FITS)
+        ef.build_time_energy_df()
+        t0 = ef.time_energy_df["TIME"].iloc[0]
+        ef.filttime(t0, t0 + 0.1)
+        assert ef.time_energy_df["TIME"].between(t0, t0 + 0.1).all()
+
+    def test_add_phase_column(self, tmp_path):
+        import shutil
+
+        work = tmp_path / "evt.fits"
+        shutil.copy(FITS, work)
+        ef = EventFile(str(work))
+        ef.add_phase_column(PAR)
+        back = fitsio.read_fits(str(work))
+        phases = back["EVENTS"].column("PHASE")
+        assert len(phases) == 89465
+        assert ((phases >= 0) & (phases < 1)).all()
+        # other columns survive the rewrite
+        np.testing.assert_array_equal(
+            back["EVENTS"].column("PI"), fitsio.read_fits(FITS)["EVENTS"].column("PI")
+        )
