@@ -19,7 +19,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-PHI = (jnp.sqrt(5.0) - 1) / 2  # golden ratio conjugate
+PHI = (5.0**0.5 - 1) / 2  # golden ratio conjugate (Python float: a module
+# import may happen inside a jit trace, so no jnp values at module scope)
 
 
 def golden_section(fn, lo, hi, iters: int = 60, maximize: bool = True):

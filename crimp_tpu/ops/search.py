@@ -22,7 +22,17 @@ loop, which is O(N_events * N_trials * n_harm) on one core):
 - multi-chip: the same partial sums psum cleanly over an event-sharded mesh
   axis (see crimp_tpu.parallel).
 
-Everything is f64: frequency resolution at 1e8-second baselines needs it.
+Precision (the key TPU design decision): the phase accumulation f*t (+
+fdot*t^2/2) runs in f64 — at 1e7-second baselines the product carries ~1e6
+cycles and needs ~13 digits — but the TRIG runs in hardware f32 on the
+mod-1-reduced fractional phase. f64 sin/cos on TPU is a ~100-op software
+emulation (measured: a full 1e5-trial x 1e6-event all-f64 scan stalls the
+chip), while the f64 multiply + floor + f32 transcendental costs a few ops.
+Accuracy: the mod-1 reduction is exact to ~1e-10 cycles in f64, and f32
+trig noise (~1e-7 per value) is orders below the sqrt(N) statistical noise
+of the Z^2/H sums. Per-block sums accumulate in f32 (tree reduction) and
+cross-block accumulation is f64. ``trig_dtype=jnp.float64`` restores the
+all-f64 path for bit-level CPU parity checks.
 """
 
 from __future__ import annotations
@@ -36,6 +46,7 @@ import pandas as pd
 
 DEFAULT_EVENT_BLOCK = 1 << 16
 DEFAULT_TRIAL_BLOCK = 256
+DEFAULT_TRIG_DTYPE = jnp.float32
 
 
 def _block_times(times: jax.Array, block: int):
@@ -50,78 +61,48 @@ def _block_times(times: jax.Array, block: int):
     return padded.reshape(n_blocks, block), weights.reshape(n_blocks, block)
 
 
-def _harmonic_sums(theta: jax.Array, weights: jax.Array, nharm: int):
-    """(C_k, S_k) for k=1..nharm where C_k = sum_i w_i cos(k theta_i).
+def _harmonic_sums_cycles(
+    phase_cycles: jax.Array, weights: jax.Array, nharm: int, trig_dtype=DEFAULT_TRIG_DTYPE
+):
+    """(C_k, S_k) for k=1..nharm where C_k = sum_i w_i cos(2 pi k phi_i).
 
-    theta: (..., B); returns arrays of shape (nharm, ...).
+    ``phase_cycles``: (..., B) model phase in CYCLES (f64); the fractional
+    part is extracted in f64, then trig + per-block sums run in
+    ``trig_dtype``. Returns f64 arrays of shape (nharm, ...).
     """
+    frac = phase_cycles - jnp.round(phase_cycles)
+    theta = (2 * np.pi) * frac.astype(trig_dtype)
+    w = weights.astype(trig_dtype)
     cos1 = jnp.cos(theta)
     sin1 = jnp.sin(theta)
     cos_km1, sin_km1 = cos1, sin1  # k-1 term
     cos_km2 = jnp.ones_like(cos1)  # k-2 term (k=0: cos=1, sin=0)
     sin_km2 = jnp.zeros_like(sin1)
-    c_list = [jnp.sum(weights * cos1, axis=-1)]
-    s_list = [jnp.sum(weights * sin1, axis=-1)]
+    c_list = [jnp.sum(w * cos1, axis=-1)]
+    s_list = [jnp.sum(w * sin1, axis=-1)]
     for _ in range(1, nharm):
         cos_k = 2 * cos1 * cos_km1 - cos_km2
         sin_k = 2 * cos1 * sin_km1 - sin_km2
-        c_list.append(jnp.sum(weights * cos_k, axis=-1))
-        s_list.append(jnp.sum(weights * sin_k, axis=-1))
+        c_list.append(jnp.sum(w * cos_k, axis=-1))
+        s_list.append(jnp.sum(w * sin_k, axis=-1))
         cos_km2, sin_km2 = cos_km1, sin_km1
         cos_km1, sin_km1 = cos_k, sin_k
-    return jnp.stack(c_list), jnp.stack(s_list)
+    return (
+        jnp.stack(c_list).astype(jnp.float64),
+        jnp.stack(s_list).astype(jnp.float64),
+    )
 
 
-@partial(jax.jit, static_argnames=("nharm", "event_block"))
-def harmonic_sums_1d(times: jax.Array, freqs: jax.Array, nharm: int, event_block: int = DEFAULT_EVENT_BLOCK):
-    """Trig sums (nharm, n_freq) over all events, blockwise-scanned."""
-    time_blocks, weight_blocks = _block_times(times, event_block)
+def _blocked_trial_sums(
+    times, freqs, nharm, event_block, trial_block, trig_dtype, phase_fn
+):
+    """Trig sums (nharm, n_freq), blocked on BOTH the trial and event axes.
 
-    def step(carry, blk):
-        t_blk, w_blk = blk
-        theta = (2 * jnp.pi) * freqs[:, None] * t_blk[None, :]
-        c, s = _harmonic_sums(theta, w_blk[None, :], nharm)
-        return (carry[0] + c, carry[1] + s), None
-
-    zeros = jnp.zeros((nharm, freqs.shape[0]), dtype=times.dtype)
-    (c_sum, s_sum), _ = jax.lax.scan(step, (zeros, zeros), (time_blocks, weight_blocks))
-    return c_sum, s_sum
-
-
-def z2_from_sums(c_sum: jax.Array, s_sum: jax.Array, n_events) -> jax.Array:
-    """Z^2 per harmonic from trig sums: (nharm, F) -> (nharm, F)."""
-    return (c_sum**2 + s_sum**2) * (2.0 / n_events)
-
-
-@partial(jax.jit, static_argnames=("nharm", "event_block"))
-def z2_power(times: jax.Array, freqs: jax.Array, nharm: int = 2, event_block: int = DEFAULT_EVENT_BLOCK) -> jax.Array:
-    """Z^2_n power at each frequency (times pre-centered by the caller)."""
-    c_sum, s_sum = harmonic_sums_1d(times, freqs, nharm, event_block)
-    return jnp.sum(z2_from_sums(c_sum, s_sum, times.shape[0]), axis=0)
-
-
-@partial(jax.jit, static_argnames=("nharm", "event_block"))
-def h_power(times: jax.Array, freqs: jax.Array, nharm: int = 20, event_block: int = DEFAULT_EVENT_BLOCK) -> jax.Array:
-    """H-test power at each frequency: max_m (cumsum Z^2_m - 4(m-1))."""
-    c_sum, s_sum = harmonic_sums_1d(times, freqs, nharm, event_block)
-    z2_cum = jnp.cumsum(z2_from_sums(c_sum, s_sum, times.shape[0]), axis=0)
-    penalties = 4.0 * jnp.arange(nharm, dtype=times.dtype)[:, None]
-    return jnp.max(z2_cum - penalties, axis=0)
-
-
-@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block"))
-def z2_power_2d(
-    times: jax.Array,
-    freqs: jax.Array,
-    fdots: jax.Array,
-    nharm: int = 2,
-    event_block: int = DEFAULT_EVENT_BLOCK,
-    trial_block: int = DEFAULT_TRIAL_BLOCK,
-) -> jax.Array:
-    """Z^2_n over the (fdot, freq) grid -> (n_fdot, n_freq).
-
-    ``fdots`` are SIGNED frequency derivatives (Hz/s); callers keeping the
-    reference CLI convention pass -10**log10grid.
+    The live intermediate is one (trial_block, event_block) phase tile —
+    HBM stays bounded no matter how many trials or events the caller asks
+    for (a 1e5-trial x 1e6-event scan would otherwise materialize a
+    multi-TB tensor). ``phase_fn(freq_blk, t_blk) -> cycles`` defines the
+    trial family (pure frequency, frequency+fdot, ...).
     """
     time_blocks, weight_blocks = _block_times(times, event_block)
     n_freq = freqs.shape[0]
@@ -130,31 +111,108 @@ def z2_power_2d(
         n_freq_blocks, trial_block
     )
 
+    def one_freq_block(freq_blk):
+        def step(carry, blk):
+            t_blk, w_blk = blk
+            phase = phase_fn(freq_blk, t_blk)  # cycles, f64
+            c, s = _harmonic_sums_cycles(phase, w_blk[None, :], nharm, trig_dtype)
+            return (carry[0] + c, carry[1] + s), None
+
+        zeros = jnp.zeros((nharm, trial_block), dtype=jnp.float64)
+        (c_sum, s_sum), _ = jax.lax.scan(step, (zeros, zeros), (time_blocks, weight_blocks))
+        return c_sum, s_sum
+
+    c_all, s_all = jax.lax.map(one_freq_block, freq_padded)  # (B, nharm, trial_block)
+    c_all = jnp.moveaxis(c_all, 1, 0).reshape(nharm, -1)[:, :n_freq]
+    s_all = jnp.moveaxis(s_all, 1, 0).reshape(nharm, -1)[:, :n_freq]
+    return c_all, s_all
+
+
+@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype"))
+def harmonic_sums_1d(
+    times: jax.Array,
+    freqs: jax.Array,
+    nharm: int,
+    event_block: int = DEFAULT_EVENT_BLOCK,
+    trial_block: int = DEFAULT_TRIAL_BLOCK,
+    trig_dtype=DEFAULT_TRIG_DTYPE,
+):
+    """Trig sums (nharm, n_freq) over all events, blockwise on both axes."""
+    return _blocked_trial_sums(
+        times, freqs, nharm, event_block, trial_block, trig_dtype,
+        lambda f_blk, t_blk: f_blk[:, None] * t_blk[None, :],
+    )
+
+
+def z2_from_sums(c_sum: jax.Array, s_sum: jax.Array, n_events) -> jax.Array:
+    """Z^2 per harmonic from trig sums: (nharm, F) -> (nharm, F)."""
+    return (c_sum**2 + s_sum**2) * (2.0 / n_events)
+
+
+@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype"))
+def z2_power(
+    times: jax.Array,
+    freqs: jax.Array,
+    nharm: int = 2,
+    event_block: int = DEFAULT_EVENT_BLOCK,
+    trial_block: int = DEFAULT_TRIAL_BLOCK,
+    trig_dtype=DEFAULT_TRIG_DTYPE,
+) -> jax.Array:
+    """Z^2_n power at each frequency (times pre-centered by the caller)."""
+    c_sum, s_sum = harmonic_sums_1d(times, freqs, nharm, event_block, trial_block, trig_dtype)
+    return jnp.sum(z2_from_sums(c_sum, s_sum, times.shape[0]), axis=0)
+
+
+@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype"))
+def h_power(
+    times: jax.Array,
+    freqs: jax.Array,
+    nharm: int = 20,
+    event_block: int = DEFAULT_EVENT_BLOCK,
+    trial_block: int = DEFAULT_TRIAL_BLOCK,
+    trig_dtype=DEFAULT_TRIG_DTYPE,
+) -> jax.Array:
+    """H-test power at each frequency: max_m (cumsum Z^2_m - 4(m-1))."""
+    c_sum, s_sum = harmonic_sums_1d(times, freqs, nharm, event_block, trial_block, trig_dtype)
+    z2_cum = jnp.cumsum(z2_from_sums(c_sum, s_sum, times.shape[0]), axis=0)
+    penalties = 4.0 * jnp.arange(nharm, dtype=times.dtype)[:, None]
+    return jnp.max(z2_cum - penalties, axis=0)
+
+
+@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype"))
+def z2_power_2d(
+    times: jax.Array,
+    freqs: jax.Array,
+    fdots: jax.Array,
+    nharm: int = 2,
+    event_block: int = DEFAULT_EVENT_BLOCK,
+    trial_block: int = DEFAULT_TRIAL_BLOCK,
+    trig_dtype=DEFAULT_TRIG_DTYPE,
+) -> jax.Array:
+    """Z^2_n over the (fdot, freq) grid -> (n_fdot, n_freq).
+
+    ``fdots`` are SIGNED frequency derivatives (Hz/s); callers keeping the
+    reference CLI convention pass -10**log10grid.
+    """
+
     def one_fdot(fdot):
-        def one_freq_block(freq_blk):
-            def step(carry, blk):
-                t_blk, w_blk = blk
-                phase = freq_blk[:, None] * t_blk[None, :] + 0.5 * fdot * t_blk[None, :] ** 2
-                c, s = _harmonic_sums((2 * jnp.pi) * phase, w_blk[None, :], nharm)
-                return (carry[0] + c, carry[1] + s), None
-
-            zeros = jnp.zeros((nharm, trial_block), dtype=times.dtype)
-            (c_sum, s_sum), _ = jax.lax.scan(
-                step, (zeros, zeros), (time_blocks, weight_blocks)
-            )
-            return jnp.sum(z2_from_sums(c_sum, s_sum, times.shape[0]), axis=0)
-
-        return jax.lax.map(one_freq_block, freq_padded).reshape(-1)[:n_freq]
+        c_sum, s_sum = _blocked_trial_sums(
+            times, freqs, nharm, event_block, trial_block, trig_dtype,
+            lambda f_blk, t_blk: f_blk[:, None] * t_blk[None, :]
+            + 0.5 * fdot * t_blk[None, :] ** 2,
+        )
+        return jnp.sum(z2_from_sums(c_sum, s_sum, times.shape[0]), axis=0)
 
     return jax.lax.map(one_fdot, fdots)
 
 
-@partial(jax.jit, static_argnames=("nharm",))
+@partial(jax.jit, static_argnames=("nharm", "trig_dtype"))
 def h_power_segments(
     times: jax.Array,  # (S, N) per-segment event times (pre-centered), padded
     masks: jax.Array,  # (S, N) validity
     freqs: jax.Array,  # (S,) one trial frequency per segment
     nharm: int = 5,
+    trig_dtype=DEFAULT_TRIG_DTYPE,
 ) -> jax.Array:
     """H-test power per segment at its own frequency, vmapped over segments.
 
@@ -162,8 +220,8 @@ def h_power_segments(
     serially per ToA, measureToAs.py:210-212)."""
 
     def one(t, m, f):
-        theta = (2 * jnp.pi) * f * t
-        c, s = _harmonic_sums(theta, m.astype(t.dtype), nharm)
+        phase = f * t  # cycles, f64
+        c, s = _harmonic_sums_cycles(phase, m.astype(t.dtype), nharm, trig_dtype)
         n = jnp.sum(m)
         z2_cum = jnp.cumsum((c**2 + s**2) * (2.0 / n))
         return jnp.max(z2_cum - 4.0 * jnp.arange(nharm, dtype=t.dtype))

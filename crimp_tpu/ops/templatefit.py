@@ -1,10 +1,13 @@
 """Binned maximum-likelihood template fitting (pulse-profile construction).
 
-Replaces the reference's lmfit-BFGS fits (pulseprofile.py:295-564) with a
-jitted ``jax.scipy.optimize.minimize`` BFGS on the Gaussian binned NLL.
-Box bounds (von Mises / Cauchy component bounds, norm positivity) are
-honored through a sigmoid reparameterization — the same mechanism lmfit
-uses for bounded gradient fits, so interior optima agree.
+Replaces the reference's lmfit-BFGS fits (pulseprofile.py:295-564) with
+scipy L-BFGS-B driving a jitted ``jax.value_and_grad`` of the Gaussian
+binned NLL. The split is deliberate: the problem is tiny (≲ 20 parameters,
+≲ 100 bins, run once per observation), so a robust host line search beats a
+fixed-iteration on-device optimizer, while the objective+gradient stay
+compiled. Box bounds (norm positivity, von Mises / Cauchy component
+bounds) map directly onto L-BFGS-B's native bound support — the same
+constraint semantics lmfit applies, so interior optima agree.
 
 Free/frozen parameters follow the template 'vary' flags: the optimizer
 works on the gathered free subvector; frozen entries stay at their inputs.
@@ -17,6 +20,7 @@ from dataclasses import replace
 import jax
 import jax.numpy as jnp
 import numpy as np
+import scipy.optimize
 
 from crimp_tpu.models.profiles import (
     CAUCHY,
@@ -88,40 +92,34 @@ def fit_binned_template(
     free_idx = np.nonzero(vary)[0]
     lo, hi = _default_bounds(kind, x0, K, float(np.max(rate)))
 
-    # Sigmoid-transform doubly-bounded free params; shift-log for one-sided.
-    lo_f = jnp.asarray(lo[free_idx])
-    hi_f = jnp.asarray(hi[free_idx])
-    both = np.isfinite(lo[free_idx]) & np.isfinite(hi[free_idx])
-    lower_only = np.isfinite(lo[free_idx]) & ~np.isfinite(hi[free_idx])
-    both = jnp.asarray(both)
-    lower_only = jnp.asarray(lower_only)
-
-    def to_bounded(u):
-        x_sig = lo_f + (hi_f - lo_f) * jax.nn.sigmoid(u)
-        x_log = lo_f + jnp.exp(jnp.clip(u, -700, 700))
-        return jnp.where(both, x_sig, jnp.where(lower_only, x_log, u))
-
-    def to_unbounded(x):
-        frac = jnp.clip((x - lo_f) / jnp.where(both, hi_f - lo_f, 1.0), 1e-9, 1 - 1e-9)
-        u_sig = jnp.log(frac) - jnp.log1p(-frac)
-        u_log = jnp.log(jnp.clip(x - lo_f, 1e-12))
-        return jnp.where(both, u_sig, jnp.where(lower_only, u_log, x))
-
     bins_j = jnp.asarray(bins)
     rate_j = jnp.asarray(rate)
     err_j = jnp.asarray(rate_err)
     x0_j = jnp.asarray(x0)
+    free_idx_j = jnp.asarray(free_idx)
 
-    def nll(u_free):
-        x_free = to_bounded(u_free)
-        vec = x0_j.at[jnp.asarray(free_idx)].set(x_free)
-        params = _unflatten(vec, init)
-        return -binned_loglik(kind, params, bins_j, rate_j, err_j)
+    @jax.jit
+    def nll_and_grad(x_free):
+        def nll(xf):
+            vec = x0_j.at[free_idx_j].set(xf)
+            params = _unflatten(vec, init)
+            return -binned_loglik(kind, params, bins_j, rate_j, err_j)
 
-    u0 = to_unbounded(jnp.asarray(x0[free_idx]))
-    result = jax.scipy.optimize.minimize(nll, u0, method="BFGS", options={"maxiter": maxiter})
-    x_free = to_bounded(result.x)
-    vec = x0_j.at[jnp.asarray(free_idx)].set(x_free)
+        return jax.value_and_grad(nll)(x_free)
+
+    def objective(x_free):
+        v, g = nll_and_grad(jnp.asarray(x_free))
+        return float(v), np.asarray(g, dtype=np.float64)
+
+    result = scipy.optimize.minimize(
+        objective,
+        x0[free_idx],
+        jac=True,
+        method="L-BFGS-B",
+        bounds=list(zip(lo[free_idx], hi[free_idx])),
+        options={"maxiter": maxiter},
+    )
+    vec = x0_j.at[free_idx_j].set(jnp.asarray(result.x))
     best = _unflatten(vec, init)
 
     from crimp_tpu.models.profiles import curve

@@ -44,6 +44,7 @@ import numpy as np
 from jax.scipy.special import i0
 
 from crimp_tpu.models.profiles import CAUCHY, FOURIER, VONMISES, ProfileParams
+from crimp_tpu.ops.optimize import golden_section
 
 # 0.5 * chi2.ppf(0.6827, df=1): the 1-sigma likelihood-profile drop
 # (measureToAs.py:324). Hard-coded to keep the kernel host-independent.
@@ -249,8 +250,6 @@ def fit_segment(kind: str, tpl: ProfileParams, x: jax.Array, mask: jax.Array, ex
     def ll_of(phi):
         ll, _ = profile_loglik(kind, tpl, x, mask, exposure, phi[None], cfg)
         return ll[0]
-
-    from crimp_tpu.ops.optimize import golden_section
 
     phi_best, ll_max = golden_section(
         ll_of, phi0 - grid_step, phi0 + grid_step, iters=cfg.refine_iters

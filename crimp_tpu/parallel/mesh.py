@@ -36,7 +36,7 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
-from crimp_tpu.ops.search import _harmonic_sums, z2_from_sums
+from crimp_tpu.ops.search import _harmonic_sums_cycles, z2_from_sums
 
 EVENT_AXIS = "events"
 TRIAL_AXIS = "trials"
@@ -72,13 +72,16 @@ def _pad_to(x: np.ndarray, multiple: int, fill=0.0):
     return out, weights
 
 
-@partial(jax.jit, static_argnames=("nharm", "mesh"))
-def _sharded_sums(times, weights, freqs, nharm: int, mesh: Mesh):
+@partial(jax.jit, static_argnames=("nharm", "mesh", "trig_dtype"))
+def _sharded_sums(times, weights, freqs, nharm: int, mesh: Mesh, trig_dtype=None):
     """Per-harmonic trig sums with events sharded + psum-reduced."""
+    from crimp_tpu.ops.search import DEFAULT_TRIG_DTYPE
+
+    dtype = DEFAULT_TRIG_DTYPE if trig_dtype is None else trig_dtype
 
     def kernel(t_shard, w_shard, f_shard):
-        theta = (2 * jnp.pi) * f_shard[:, None] * t_shard[None, :]
-        c, s = _harmonic_sums(theta, w_shard[None, :], nharm)
+        phase = f_shard[:, None] * t_shard[None, :]  # cycles, f64
+        c, s = _harmonic_sums_cycles(phase, w_shard[None, :], nharm, dtype)
         c = jax.lax.psum(c, EVENT_AXIS)
         s = jax.lax.psum(s, EVENT_AXIS)
         return c, s
@@ -91,7 +94,7 @@ def _sharded_sums(times, weights, freqs, nharm: int, mesh: Mesh):
     )(times, weights, freqs)
 
 
-def z2_sharded(times, freqs, nharm: int = 2, mesh: Mesh | None = None) -> np.ndarray:
+def z2_sharded(times, freqs, nharm: int = 2, mesh: Mesh | None = None, trig_dtype=None) -> np.ndarray:
     """Z^2_n over the frequency grid, events sharded across the mesh."""
     if mesh is None:
         mesh = build_mesh()
@@ -100,12 +103,14 @@ def z2_sharded(times, freqs, nharm: int = 2, mesh: Mesh | None = None) -> np.nda
     tr_size = mesh.shape[TRIAL_AXIS]
     t_pad, w_pad = _pad_to(np.asarray(times, dtype=np.float64), ev_size)
     f_pad, f_w = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
-    c, s = _sharded_sums(jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad), nharm, mesh)
+    c, s = _sharded_sums(
+        jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad), nharm, mesh, trig_dtype
+    )
     power = np.asarray(jnp.sum(z2_from_sums(c, s, n_events), axis=0))
     return power[: len(freqs)]
 
 
-def h_sharded(times, freqs, nharm: int = 20, mesh: Mesh | None = None) -> np.ndarray:
+def h_sharded(times, freqs, nharm: int = 20, mesh: Mesh | None = None, trig_dtype=None) -> np.ndarray:
     """H-test over the frequency grid, events sharded across the mesh."""
     if mesh is None:
         mesh = build_mesh()
@@ -114,7 +119,9 @@ def h_sharded(times, freqs, nharm: int = 20, mesh: Mesh | None = None) -> np.nda
     tr_size = mesh.shape[TRIAL_AXIS]
     t_pad, w_pad = _pad_to(np.asarray(times, dtype=np.float64), ev_size)
     f_pad, _ = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
-    c, s = _sharded_sums(jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad), nharm, mesh)
+    c, s = _sharded_sums(
+        jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad), nharm, mesh, trig_dtype
+    )
     z2_cum = jnp.cumsum(z2_from_sums(c, s, n_events), axis=0)
     penalties = 4.0 * jnp.arange(nharm)[:, None]
     return np.asarray(jnp.max(z2_cum - penalties, axis=0))[: len(freqs)]

@@ -30,10 +30,16 @@ def configure_logging(
     if force:
         for handler in list(root.handlers):
             root.removeHandler(handler)
-    root.setLevel(logging.DEBUG)
+    # Root sits at the lowest level any of our handlers wants — NOT at DEBUG:
+    # third-party libraries (jax) attach their own stderr handlers that
+    # inherit the root's effective level, so an unconditional DEBUG root
+    # floods the console with their internals.
+    console_lvl = getattr(logging, console_level.upper(), logging.WARNING)
+    file_lvl = getattr(logging, file_level.upper(), logging.INFO)
+    root.setLevel(min(console_lvl, file_lvl) if file_path else console_lvl)
 
     console = logging.StreamHandler()
-    console.setLevel(getattr(logging, console_level.upper(), logging.WARNING))
+    console.setLevel(console_lvl)
     console.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
     root.addHandler(console)
 
@@ -43,7 +49,7 @@ def configure_logging(
         file_handler = RotatingFileHandler(
             file_path, mode="w", maxBytes=file_max_bytes, backupCount=file_backup_count
         )
-        file_handler.setLevel(getattr(logging, file_level.upper(), logging.INFO))
+        file_handler.setLevel(file_lvl)
         file_handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
         root.addHandler(file_handler)
 

@@ -32,13 +32,21 @@ def sim_events():
 
 class TestZ2:
     def test_matches_naive_formula(self):
+        """f64 path: bit-level parity; mixed (default f32-trig) path: within
+        the f32 noise floor, orders below the sqrt(N) statistical noise."""
+        import jax.numpy as jnp
+
         rng = np.random.RandomState(0)
         times = np.sort(rng.uniform(0, 500, 2000))
         freqs = np.linspace(0.05, 0.3, 37)
         for nharm in (1, 2, 5):
-            mine = np.asarray(search.z2_power(times, freqs, nharm, event_block=256))
             ref = naive_z2(times, freqs, nharm)
-            np.testing.assert_allclose(mine, ref, rtol=1e-8, atol=1e-6)
+            exact = np.asarray(
+                search.z2_power(times, freqs, nharm, event_block=256, trig_dtype=jnp.float64)
+            )
+            np.testing.assert_allclose(exact, ref, rtol=1e-8, atol=1e-6)
+            mixed = np.asarray(search.z2_power(times, freqs, nharm, event_block=256))
+            np.testing.assert_allclose(mixed, ref, rtol=1e-4, atol=5e-3)
 
     def test_blocking_invariance(self):
         rng = np.random.RandomState(1)
@@ -46,7 +54,7 @@ class TestZ2:
         freqs = np.linspace(0.1, 1.0, 11)
         a = np.asarray(search.z2_power(times, freqs, 2, event_block=128))
         b = np.asarray(search.z2_power(times, freqs, 2, event_block=4096))
-        np.testing.assert_allclose(a, b, rtol=1e-9, atol=1e-7)
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-3)
 
     def test_recovers_injected_frequency(self, sim_events):
         ps = search.PeriodSearch(sim_events, np.linspace(0.245, 0.255, 201), nbrHarm=2)
@@ -71,13 +79,17 @@ class TestHTest:
         times = np.sort(rng.uniform(0, 300, 1500))
         freqs = np.linspace(0.2, 0.4, 21)
         nharm = 6
-        h = np.asarray(search.h_power(times, freqs, nharm))
+        import jax.numpy as jnp
+
+        h = np.asarray(search.h_power(times, freqs, nharm, trig_dtype=jnp.float64))
         # manual reconstruction from per-harmonic Z^2 terms
         z_terms = np.array(
             [naive_z2(times, freqs, k) for k in range(1, nharm + 1)]
         )  # cumulative by construction
         manual = np.max(z_terms - 4 * np.arange(nharm)[:, None], axis=0)
         np.testing.assert_allclose(h, manual, rtol=1e-8, atol=1e-6)
+        mixed = np.asarray(search.h_power(times, freqs, nharm))
+        np.testing.assert_allclose(mixed, manual, rtol=1e-4, atol=5e-3)
 
     def test_h_at_least_z21(self, sim_events):
         ps = search.PeriodSearch(sim_events, np.array([0.25]), nbrHarm=5)
