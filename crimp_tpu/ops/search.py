@@ -47,6 +47,10 @@ import pandas as pd
 DEFAULT_EVENT_BLOCK = 1 << 16
 DEFAULT_TRIAL_BLOCK = 256
 DEFAULT_TRIG_DTYPE = jnp.float32
+# Grid fast path: measured optimum on TPU v5e (34.6k vs 33.1k trials/s for
+# the general defaults; see docs/performance.md).
+GRID_EVENT_BLOCK = 1 << 15
+GRID_TRIAL_BLOCK = 512
 
 
 def _block_times(times: jax.Array, block: int):
@@ -179,6 +183,117 @@ def h_power(
     return jnp.max(z2_cum - penalties, axis=0)
 
 
+# ---------------------------------------------------------------------------
+# Uniform-grid fast path
+# ---------------------------------------------------------------------------
+
+
+def uniform_grid(freqs: np.ndarray, rtol: float = 1e-12):
+    """(f0, df) if ``freqs`` is a uniform grid, else None (host helper)."""
+    f = np.asarray(freqs, dtype=np.float64)
+    if f.ndim != 1 or f.size < 3:
+        return None
+    df = (f[-1] - f[0]) / (f.size - 1)
+    if df == 0:
+        return None
+    recon = f[0] + df * np.arange(f.size)
+    scale = max(abs(f[0]), abs(f[-1]))
+    if np.max(np.abs(recon - f)) > rtol * scale:
+        return None
+    return float(f[0]), float(df)
+
+
+@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block", "trial_block"))
+def harmonic_sums_uniform(
+    times: jax.Array,
+    f0: float,
+    df: float,
+    n_freq: int,
+    nharm: int,
+    event_block: int = GRID_EVENT_BLOCK,
+    trial_block: int = GRID_TRIAL_BLOCK,
+):
+    """Trig sums over the uniform grid f0 + j*df — the f64-lean fast path.
+
+    Writing the trial index j = j0 + j_lo (tiles of ``trial_block``), the
+    phase splits as f_j*t = [f0*t + (j0*df)*t] + j_lo*(df*t): the bracket is
+    ONE f64 row per tile (mod-1 reduced exactly), and the inner j_lo sweep
+    is pure f32 on frac(df*t) wrapped to [-0.5, 0.5), so its magnitude is
+    bounded by trial_block/2 cycles — worst-case f32 frac accuracy
+    ~trial_block/2 * 2^-24 ≈ 1.5e-5 cycles at the default tile (fine ToA
+    grids with df*t << 1 sit near 1e-7). Both are far below the sqrt(N)
+    noise of the statistic. The split removes (trial_block-1)/trial_block
+    of the f64 work of the general path (f64 is software-emulated on TPU;
+    measured +38% trials/s end-to-end on v5e).
+    """
+    time_blocks, weight_blocks = _block_times(times, event_block)
+    n_tiles = -(-n_freq // trial_block)
+    j_lo = jnp.arange(trial_block, dtype=jnp.float32)
+    # b = df*t reduced mod 1 ONCE in f64 (O(N)); j_lo*b only ever needs the
+    # fractional part since frac(j_lo*(b_int + b_frac)) = frac(j_lo*b_frac).
+    # Wrapping to [-0.5, 0.5) bounds |j_lo*b| <= trial_block/2 cycles, so
+    # the f32 frac extraction keeps ~1e-5-cycle accuracy even for coarse
+    # grids (fine ToA-search grids sit orders below that).
+    b_raw = df * time_blocks
+    b_blocks = (b_raw - jnp.round(b_raw)).astype(jnp.float32)
+
+    def one_tile(tile_idx):
+        f_tile = f0 + (tile_idx * trial_block) * df  # f64 scalar
+
+        def step(carry, blk):
+            t_blk, w_blk, b_blk = blk
+            base = f_tile * t_blk  # f64: one row per tile
+            cb = (base - jnp.round(base)).astype(jnp.float32)
+            phase32 = cb[None, :] + j_lo[:, None] * b_blk[None, :]
+            c, s = _harmonic_sums_cycles(phase32, w_blk[None, :].astype(jnp.float32), nharm, jnp.float32)
+            return (carry[0] + c, carry[1] + s), None
+
+        zeros = jnp.zeros((nharm, trial_block), dtype=jnp.float64)
+        (c_sum, s_sum), _ = jax.lax.scan(
+            step, (zeros, zeros), (time_blocks, weight_blocks, b_blocks)
+        )
+        return c_sum, s_sum
+
+    c_all, s_all = jax.lax.map(one_tile, jnp.arange(n_tiles, dtype=jnp.float64))
+    c_all = jnp.moveaxis(c_all, 1, 0).reshape(nharm, -1)[:, :n_freq]
+    s_all = jnp.moveaxis(s_all, 1, 0).reshape(nharm, -1)[:, :n_freq]
+    return c_all, s_all
+
+
+def z2_power_grid(
+    times,
+    f0: float,
+    df: float,
+    n_freq: int,
+    nharm: int = 2,
+    event_block: int = GRID_EVENT_BLOCK,
+    trial_block: int = GRID_TRIAL_BLOCK,
+) -> jax.Array:
+    """Z^2_n over the uniform grid f0 + j*df (fast path; see above)."""
+    c, s = harmonic_sums_uniform(
+        jnp.asarray(times), f0, df, n_freq, nharm, event_block, trial_block
+    )
+    return jnp.sum(z2_from_sums(c, s, np.shape(times)[0]), axis=0)
+
+
+def h_power_grid(
+    times,
+    f0: float,
+    df: float,
+    n_freq: int,
+    nharm: int = 20,
+    event_block: int = GRID_EVENT_BLOCK,
+    trial_block: int = GRID_TRIAL_BLOCK,
+) -> jax.Array:
+    """H-test over the uniform grid f0 + j*df (fast path)."""
+    c, s = harmonic_sums_uniform(
+        jnp.asarray(times), f0, df, n_freq, nharm, event_block, trial_block
+    )
+    z2_cum = jnp.cumsum(z2_from_sums(c, s, np.shape(times)[0]), axis=0)
+    penalties = 4.0 * jnp.arange(nharm, dtype=jnp.float64)[:, None]
+    return jnp.max(z2_cum - penalties, axis=0)
+
+
 @partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype"))
 def z2_power_2d(
     times: jax.Array,
@@ -246,9 +361,21 @@ class PeriodSearch:
         return jnp.asarray(self.time - self.t0)
 
     def ztest(self) -> np.ndarray:
+        grid = uniform_grid(self.freq)
+        if grid is not None:
+            f0, df = grid
+            return np.asarray(
+                z2_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm)
+            )
         return np.asarray(z2_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm))
 
     def htest(self) -> np.ndarray:
+        grid = uniform_grid(self.freq)
+        if grid is not None:
+            f0, df = grid
+            return np.asarray(
+                h_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm)
+            )
         return np.asarray(h_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm))
 
     def twod_ztest(self, freq_dot):

@@ -43,8 +43,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.scipy.special import i0
 
-from crimp_tpu.models.profiles import CAUCHY, FOURIER, VONMISES, ProfileParams
-from crimp_tpu.ops.optimize import golden_section
+from crimp_tpu.models.profiles import (
+    CAUCHY,
+    FOURIER,
+    VONMISES,
+    ProfileParams,
+    extended_loglik,
+)
+from crimp_tpu.ops.optimize import bounded_transform, golden_section, nelder_mead
 
 # 0.5 * chi2.ppf(0.6827, df=1): the 1-sigma likelihood-profile drop
 # (measureToAs.py:324). Hard-coded to keep the kernel host-independent.
@@ -66,6 +72,18 @@ class ToAFitConfig(NamedTuple):
     vary_amps: bool = False  # free ampShift (3-parameter fit)
     amp_lo: float = 0.01
     amp_hi: float = 100.0
+    # General free-parameter path (the reference's readvaryparam mode,
+    # defineinitialfitparam): indices into the flattened template vector
+    # [norm, amp_1..K, loc_1..K, wid_1..K, ampShift] that are free, with
+    # per-parameter box bounds. Empty = fast fixed-shape path.
+    free_idx: tuple = ()
+    free_lo: tuple = ()
+    free_hi: tuple = ()
+    nm_iters: int = 150  # Nelder-Mead iterations of the general path
+    n_free: int = -1  # chi2 dof override (-1 = auto: 2 + vary_amps)
+    fix_norm: bool = False  # pin the norm at the template value (the
+    # readvaryparam all-fixed case: reference keeps nbrFreeParams=0 and
+    # does NOT free the norm, defineinitialfitparam readvaryparam branch)
 
 
 def _phase_range(kind: str) -> float:
@@ -141,16 +159,66 @@ def _optimal_norm(s: jax.Array, mask: jax.Array, exposure, n_events, lo, hi, ite
     return jax.lax.fori_loop(0, iters, body, a)
 
 
-def _loglik_at(kind, tpl, s, a, mask, exposure, n_events):
-    """Extended LL given shape values s (P,N) and norms a (P,)."""
-    vals = a[:, None] + s
+def _optimal_norm_amp(
+    kind, tpl, s, mask, exposure, n_events, cfg: "ToAFitConfig"
+):
+    """Joint concave inner solve for (A, b) = (norm, ampShift), per grid point.
+
+    LL(A, b) = -A*T - c_b*b*T + sum_i m_i log(A + b*s_i) + const is jointly
+    concave (log of an affine form), so a projected 2x2 Newton ascent
+    converges in a few iterations; boxes follow the reference's second-stage
+    refit bounds (measureToAs.py:308,461,605). s: (P, N); returns (A, b).
+    """
+    q0 = jnp.sum(tpl.amp * tpl.amp_shift)
+    c_b = 0.0 if kind == FOURIER else q0 / (2 * jnp.pi)
+
+    a_lo = cfg.norm_lo_frac * tpl.norm
+    a_hi = cfg.norm_hi
+    b_lo, b_hi = cfg.amp_lo, cfg.amp_hi
+    min_s = jnp.min(jnp.where(mask[None, :], s, jnp.inf), axis=1)
+
+    def feasible_a_lo(b):
+        # keep A + b*s_i > 0 for every masked event
+        return jnp.maximum(a_lo, -b * min_s * (1 + 1e-9) + 1e-12)
+
+    a0 = jnp.clip(
+        jnp.full(s.shape[0], n_events / exposure), feasible_a_lo(jnp.ones(s.shape[0])), a_hi
+    )
+    b0 = jnp.ones(s.shape[0])
+
+    def body(_, state):
+        a, b = state
+        denom = a[:, None] + b[:, None] * s
+        inv = jnp.where(mask[None, :], 1.0 / denom, 0.0)
+        inv_s = inv * s
+        g_a = jnp.sum(inv, axis=1) - exposure
+        g_b = jnp.sum(inv_s, axis=1) - c_b * exposure
+        h_aa = -jnp.sum(inv**2, axis=1)
+        h_ab = -jnp.sum(inv * inv_s, axis=1)
+        h_bb = -jnp.sum(inv_s**2, axis=1)
+        det = h_aa * h_bb - h_ab**2
+        # Damped fallback when the Hessian is near-singular (flat shape).
+        safe = jnp.abs(det) > 1e-30
+        det = jnp.where(safe, det, 1.0)
+        da = jnp.where(safe, -(h_bb * g_a - h_ab * g_b) / det, g_a / (-h_aa - 1e-30))
+        db = jnp.where(safe, -(-h_ab * g_a + h_aa * g_b) / det, 0.0)
+        b_new = jnp.clip(b + db, b_lo, b_hi)
+        a_new = jnp.clip(a + da, feasible_a_lo(b_new), a_hi)
+        return a_new, b_new
+
+    return jax.lax.fori_loop(0, 2 * cfg.newton_iters, body, (a0, b0))
+
+
+def _loglik_at(kind, tpl, s, a, b, mask, exposure, n_events):
+    """Extended LL given shape values s (P,N), norms a (P,), ampShifts b (P,)."""
+    vals = a[:, None] + b[:, None] * s
     positive = jnp.min(jnp.where(mask[None, :], vals, jnp.inf), axis=1) > 0
     log_sum = jnp.sum(jnp.where(mask[None, :], jnp.log(jnp.clip(vals, 1e-300)), 0.0), axis=1)
     if kind == FOURIER:
         const = n_events * jnp.log(exposure)
         ll = -a * exposure + const + log_sum
     else:
-        q = jnp.sum(tpl.amp * tpl.amp_shift)
+        q = jnp.sum(tpl.amp * tpl.amp_shift) * b
         const = n_events * jnp.log(exposure / (2 * jnp.pi)) - q * exposure / (2 * jnp.pi)
         ll = -a * exposure + const + log_sum
     return jnp.where(positive, ll, -jnp.inf)
@@ -158,12 +226,170 @@ def _loglik_at(kind, tpl, s, a, mask, exposure, n_events):
 
 def profile_loglik(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig):
     """(LL(phi), A*(phi)) profile with the norm re-optimized per shift."""
+    ll, a, _ = profile_loglik_full(kind, tpl, x, mask, exposure, phis, cfg)
+    return ll, a
+
+
+def profile_loglik_full(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig):
+    """(LL(phi), A*(phi), b*(phi)): profile over phShift with the nuisance
+    parameters re-optimized per shift — the vectorized analog of the
+    reference's per-step refits. Dispatches to the general Nelder-Mead
+    path when cfg.free_idx names extra free template parameters."""
+    if cfg.free_idx:
+        return _general_profile_loglik(kind, tpl, x, mask, exposure, phis, cfg)
     n_events = jnp.sum(mask)
     s = shape_at_shifts(kind, tpl, x, phis)
-    lo = cfg.norm_lo_frac * tpl.norm
-    a = _optimal_norm(s, mask, exposure, n_events, lo, cfg.norm_hi, cfg.newton_iters)
-    ll = _loglik_at(kind, tpl, s, a, mask, exposure, n_events)
-    return ll, a
+    if cfg.vary_amps:
+        a, b = _optimal_norm_amp(kind, tpl, s, mask, exposure, n_events, cfg)
+    elif cfg.fix_norm:
+        a = jnp.full(s.shape[0], tpl.norm)
+        b = jnp.ones_like(a)
+    else:
+        lo = cfg.norm_lo_frac * tpl.norm
+        a = _optimal_norm(s, mask, exposure, n_events, lo, cfg.norm_hi, cfg.newton_iters)
+        b = jnp.ones_like(a)
+    ll = _loglik_at(kind, tpl, s, a, b, mask, exposure, n_events)
+    return ll, a, b
+
+
+# ---------------------------------------------------------------------------
+# General free-parameter path (readvaryparam)
+# ---------------------------------------------------------------------------
+
+
+def _flatten_tpl(tpl: ProfileParams) -> jax.Array:
+    """[norm, amp_1..K, loc_1..K, wid_1..K, ampShift] flattened vector."""
+    return jnp.concatenate(
+        [tpl.norm[None], tpl.amp, tpl.loc, tpl.wid, tpl.amp_shift[None]]
+    )
+
+
+def _unflatten_tpl(vec: jax.Array, tpl: ProfileParams) -> ProfileParams:
+    K = tpl.n_comp
+    return tpl.replace(
+        norm=vec[0],
+        amp=vec[1 : 1 + K],
+        loc=vec[1 + K : 1 + 2 * K],
+        wid=vec[1 + 2 * K : 1 + 3 * K],
+        amp_shift=vec[1 + 3 * K],
+    )
+
+
+def free_param_spec(kind: str, template: dict, vary_amps: bool = False):
+    """(free_idx, lo, hi, n_free) from a template dict's 'vary' flags.
+
+    Mirrors defineinitialfitparam's readvaryparam bounds
+    (measureToAs.py:727-806): norm in [val/5, 5*val]; Fourier amp in
+    [0, 1000], ph in [-pi, pi]; vm/cauchy amp in [0, 5*val], cen in
+    val +/- 0.6, wid in [0, 30*pi]. ``n_free`` reproduces the reference's
+    free-parameter count for the chi2 dof (which counts the varying
+    template parameters but NOT phShift in this mode — a reference quirk
+    preserved for parity).
+    """
+    K = int(template["nbrComp"])
+
+    def varies(key):
+        entry = template[key]
+        return bool(entry["vary"]) if isinstance(entry, dict) else False
+
+    def value(key):
+        entry = template[key]
+        return float(entry["value"]) if isinstance(entry, dict) else float(entry)
+
+    idx, lo, hi = [], [], []
+    n_free = 0
+    if varies("norm"):
+        idx.append(0)
+        lo.append(value("norm") / 5)
+        hi.append(value("norm") * 5)
+        n_free += 1
+    for k in range(1, K + 1):
+        if varies(f"amp_{k}"):
+            idx.append(k)
+            if kind == FOURIER:
+                lo.append(0.0)
+                hi.append(1000.0)
+            else:
+                lo.append(0.0)
+                hi.append(5 * value(f"amp_{k}"))
+            n_free += 1
+        loc_key = f"ph_{k}" if kind == FOURIER else f"cen_{k}"
+        if varies(loc_key):
+            idx.append(K + k)
+            if kind == FOURIER:
+                lo.append(-np.pi)
+                hi.append(np.pi)
+            else:
+                lo.append(value(loc_key) - 0.6)
+                hi.append(value(loc_key) + 0.6)
+            n_free += 1
+        if kind != FOURIER and varies(f"wid_{k}"):
+            idx.append(2 * K + k)
+            lo.append(0.0)
+            hi.append(30 * np.pi)
+            n_free += 1
+    if vary_amps:
+        idx.append(3 * K + 1)
+        lo.append(0.01 if kind == FOURIER else 1e-6)
+        hi.append(100.0 if kind == FOURIER else (500.0 if kind == VONMISES else 1e6))
+        n_free += 1
+
+    # Widen any box that excludes its own template value (e.g. a Fourier
+    # phase written outside [-pi, pi], or an amplitude > 1000): the sigmoid
+    # reparameterization would otherwise clip the start point to the
+    # boundary and freeze the parameter there with ~zero gradient.
+    flat_vals = [value("norm")]
+    for k in range(1, K + 1):
+        flat_vals.append(value(f"amp_{k}"))
+    for k in range(1, K + 1):
+        flat_vals.append(value(f"ph_{k}" if kind == FOURIER else f"cen_{k}"))
+    for k in range(1, K + 1):
+        flat_vals.append(value(f"wid_{k}") if kind != FOURIER else 0.0)
+    flat_vals.append(1.0)  # ampShift starts at 1
+    for pos, i in enumerate(idx):
+        v = flat_vals[i]
+        margin = abs(v) * 1e-6 + 1e-9
+        if v - margin < lo[pos]:
+            lo[pos] = v - margin
+        if v + margin > hi[pos]:
+            hi[pos] = v + margin
+    return tuple(idx), tuple(lo), tuple(hi), n_free
+
+
+def _general_profile_vecs(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig):
+    """Profile LL over phShift with ALL flagged template parameters refit per
+    shift by a fixed-iteration bounded Nelder-Mead (vmapped over the grid);
+    returns (LL, full refit parameter vector) per grid point.
+
+    This is the batched equivalent of the reference's readvaryparam mode,
+    where every error-scan step re-runs lmfit over the free parameter set
+    (measureToAs.py:331-376 with vary flags from defineinitialfitparam).
+    """
+    free_idx = jnp.asarray(cfg.free_idx, dtype=jnp.int32)
+    tf = bounded_transform(jnp.asarray(cfg.free_lo), jnp.asarray(cfg.free_hi))
+    base = _flatten_tpl(tpl)
+    u0 = tf.to_unbounded(base[free_idx])
+
+    def one_phi(phi):
+        def nll(u):
+            vec = base.at[free_idx].set(tf.to_bounded(u))
+            p = _unflatten_tpl(vec, tpl).replace(ph_shift=phi)
+            return -extended_loglik(kind, p, x, exposure, mask)
+
+        u_best, f_best = nelder_mead(nll, u0, init_scale=0.25, iters=cfg.nm_iters)
+        vec_best = base.at[free_idx].set(tf.to_bounded(u_best))
+        return -f_best, vec_best
+
+    ll, vecs = jax.vmap(one_phi)(phis)
+    return ll, vecs
+
+
+def _general_profile_loglik(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig):
+    """(LL, norm, ampShift) view of the general profile (API twin of the
+    fixed-shape branch; fit_segment uses _general_profile_vecs directly when
+    it also needs the refit shape vector)."""
+    ll, vecs = _general_profile_vecs(kind, tpl, x, mask, exposure, phis, cfg)
+    return ll, vecs[:, 0], vecs[:, 1 + 3 * tpl.n_comp]
 
 
 # ---------------------------------------------------------------------------
@@ -171,7 +397,7 @@ def profile_loglik(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig):
 # ---------------------------------------------------------------------------
 
 
-def _binned_chi2(kind, tpl, x, mask, exposure, phi_best, a_best, cfg: ToAFitConfig):
+def _binned_chi2(kind, tpl, x, mask, exposure, phi_best, a_best, b_best, cfg: ToAFitConfig):
     """chi2 of the binned profile against the best-fit model
     (measureToAs.py:383-393 semantics; mask-safe for empty bins)."""
     upper = 1.0 if kind == FOURIER else 2 * jnp.pi
@@ -184,12 +410,14 @@ def _binned_chi2(kind, tpl, x, mask, exposure, phi_best, a_best, cfg: ToAFitConf
     centers = (jnp.arange(nbins, dtype=x.dtype) + 0.5) * (upper / nbins)
     model = (
         a_best
-        + shape_at_shifts(kind, tpl, centers, jnp.asarray([phi_best]))[0]
+        + b_best * shape_at_shifts(kind, tpl, centers, jnp.asarray([phi_best]))[0]
     )
     valid = counts > 0
     chi2 = jnp.sum(jnp.where(valid, (model - rate) ** 2 / jnp.where(valid, rate_err, 1.0) ** 2, 0.0))
-    n_free = 2 + (1 if cfg.vary_amps else 0)
-    return chi2 / (nbins - n_free)
+    n_free = cfg.n_free if cfg.n_free >= 0 else 2 + (1 if cfg.vary_amps else 0)
+    # a heavily-parameterized readvaryparam fit can exhaust the bins; clamp
+    # the dof at 1 so the reported redChi2 stays finite and positive
+    return chi2 / max(nbins - n_free, 1)
 
 
 def _error_scan(kind, tpl, x, mask, exposure, phi_best, ll_max, cfg: ToAFitConfig):
@@ -254,22 +482,52 @@ def fit_segment(kind: str, tpl: ProfileParams, x: jax.Array, mask: jax.Array, ex
     phi_best, ll_max = golden_section(
         ll_of, phi0 - grid_step, phi0 + grid_step, iters=cfg.refine_iters
     )
-    _, a_best_arr = profile_loglik(kind, tpl, x, mask, exposure, phi_best[None], cfg)
-    a_best = a_best_arr[0]
 
-    # 3) likelihood-profile error bounds
+    # 3) nuisance parameters at the optimum — ONE solve at phi_best; general
+    #    mode also yields the full refit shape vector for the chi2 model
+    if cfg.free_idx:
+        _, vecs = _general_profile_vecs(
+            kind, tpl, x, mask, exposure, phi_best[None], cfg
+        )
+        vec_best = vecs[0]
+        a_best = vec_best[0]
+        b_best = vec_best[1 + 3 * tpl.n_comp]
+    else:
+        _, a_best_arr, b_best_arr = profile_loglik_full(
+            kind, tpl, x, mask, exposure, phi_best[None], cfg
+        )
+        a_best = a_best_arr[0]
+        b_best = b_best_arr[0]
+        vec_best = (
+            _flatten_tpl(tpl).at[0].set(a_best).at[1 + 3 * tpl.n_comp].set(b_best)
+        )
+
+    # 4) likelihood-profile error bounds
     err_lo, err_hi = _error_scan(kind, tpl, x, mask, exposure, phi_best, ll_max, cfg)
 
-    # 4) binned-profile goodness of fit
-    red_chi2 = _binned_chi2(kind, tpl, x, mask, exposure, phi_best, a_best, cfg)
+    # 5) binned-profile goodness of fit (general mode evaluates the model at
+    #    the REFIT shape parameters, with ampShift folded into the template)
+    if cfg.free_idx:
+        tpl_chi2 = _unflatten_tpl(vec_best, tpl)
+        red_chi2 = _binned_chi2(
+            kind, tpl_chi2, x, mask, exposure, phi_best, vec_best[0],
+            jnp.ones(()), cfg,
+        )
+    else:
+        red_chi2 = _binned_chi2(kind, tpl, x, mask, exposure, phi_best, a_best, b_best, cfg)
 
     return {
         "phShift": phi_best,
         "phShift_LL": err_lo,
         "phShift_UL": err_hi,
         "norm": a_best,
+        "ampShift": b_best,
         "logLmax": ll_max,
         "redChi2": red_chi2,
+        # full flattened best-fit parameter vector [norm, amps, locs, wids,
+        # ampShift] — in general (readvaryparam) mode this carries the REFIT
+        # shape, which callers must use to reproduce the fitted model
+        "theta_best": vec_best,
     }
 
 

@@ -62,7 +62,7 @@ def _plotly_dashboard(table: pd.DataFrame, source: str, outputFile: str) -> None
         height=1600, width=1600, showlegend=False,
         title_text="ToA properties for file " + source, font=dict(size=14),
     )
-    fig.write_html("./" + outputFile + ".html")
+    fig.write_html(outputFile + ".html")
 
 
 def _svg_panel(x, y, yerr, xlabel, ylabel, width=700, height=190) -> str:
@@ -130,7 +130,7 @@ def _fallback_dashboard(table: pd.DataFrame, source: str, outputFile: str) -> No
         f"<h2>ToA properties for file {html.escape(source)}</h2>"
         "<table>" + "".join(cells) + "</table></body></html>"
     )
-    with open("./" + outputFile + ".html", "w") as fh:
+    with open(outputFile + ".html", "w") as fh:
         fh.write(page)
 
 

@@ -63,13 +63,6 @@ def measure_toas(
         evtFile, timMod, tempModPP, toagtifile, eneLow, eneHigh, toaStart, toaEnd,
         phShiftRes, nbrBins, varyAmps, readvaryparam, brutemin, toaFile, timFile,
     )
-    if readvaryparam or varyAmps:
-        raise NotImplementedError(
-            "readvaryparam / varyAmps (extra free parameters in the ToA fit) "
-            "land with the general Nelder-Mead ToA path; the default "
-            "fixed-shape path is available."
-        )
-
     ef = EventFile(evtFile)
     df = ef.build_time_energy_df().filtenergy(eneLow, eneHigh).time_energy_df
     times_all = df["TIME"].to_numpy()
@@ -120,12 +113,40 @@ def measure_toas(
     if kind in (profiles.CAUCHY, profiles.VONMISES):
         phases = phases * (2 * np.pi)  # radians convention (measureToAs.py:195-200)
 
-    cfg = toafit.ToAFitConfig(
-        kind=kind,
-        ph_shift_res=phShiftRes,
-        nbins=nbrBins,
-        vary_amps=varyAmps,
-    )
+    if readvaryparam:
+        # General path: free parameters follow the template 'vary' flags
+        # (reference defineinitialfitparam readvaryparam mode); ampShift is
+        # appended to the free set when varyAmps is also requested.
+        free_idx, free_lo, free_hi, n_free = toafit.free_param_spec(
+            kind, tpl_dict, vary_amps=varyAmps
+        )
+        cfg = toafit.ToAFitConfig(
+            kind=kind,
+            ph_shift_res=phShiftRes,
+            nbins=nbrBins,
+            free_idx=free_idx,
+            free_lo=free_lo,
+            free_hi=free_hi,
+            n_free=n_free,
+            # all-fixed template: only phShift floats and the norm stays at
+            # the template value (reference readvaryparam with no vary flags)
+            fix_norm=not free_idx,
+        )
+    else:
+        # ampShift box bounds per family (measureToAs.py:308,461,605)
+        amp_lo, amp_hi = {
+            profiles.FOURIER: (0.01, 100.0),
+            profiles.CAUCHY: (1e-6, 1e6),  # reference: [0, inf)
+            profiles.VONMISES: (1e-6, 500.0),
+        }[kind]
+        cfg = toafit.ToAFitConfig(
+            kind=kind,
+            ph_shift_res=phShiftRes,
+            nbins=nbrBins,
+            vary_amps=varyAmps,
+            amp_lo=amp_lo,
+            amp_hi=amp_hi,
+        )
     exp_batch = exposures[toaStart:toaEnd].astype(float)
     results = toafit.fit_toas_batch(
         kind, tpl, phases, masks, exp_batch, cfg
@@ -193,13 +214,16 @@ def _diagnostic_plots(kind, tpl, phases, masks, exposures, results, cfg, toa_ids
     import jax.numpy as jnp
 
     from crimp_tpu.ops.binprofile import bin_phases
-    from crimp_tpu.ops.toafit import profile_loglik, shape_at_shifts
+    from crimp_tpu.ops.toafit import _unflatten_tpl, profile_loglik, shape_at_shifts
 
     half = np.pi if kind == profiles.FOURIER else 1.5 * np.pi
     for out_i, toa_id in enumerate(toa_ids):
         x = phases[out_i][masks[out_i].astype(bool)]
         exposure = exposures[out_i]
         phi_best = results["phShift"][out_i]
+        # per-ToA best-fit template (carries the REFIT shape in
+        # readvaryparam mode, where amps/locs/wids may have moved)
+        tpl_best = _unflatten_tpl(jnp.asarray(results["theta_best"][out_i]), tpl)
         if plotLLs:
             span = 40 * (2 * np.pi / cfg.ph_shift_res)
             phis = np.linspace(phi_best - span, phi_best + span, 161)
@@ -217,8 +241,10 @@ def _diagnostic_plots(kind, tpl, phases, masks, exposures, results, cfg, toa_ids
             rate = binned["ctsBins"] / per_bin
             err = binned["ctsBinsErr"] / per_bin
             centers = binned["ppBins"]
-            model_best = results["norm"][out_i] + np.asarray(
-                shape_at_shifts(kind, tpl, jnp.asarray(centers), jnp.asarray([phi_best]))
+            # tpl_best already folds norm/ampShift (and any refit shape
+            # params) into the template, so only the shape term is added
+            model_best = float(tpl_best.norm) + np.asarray(
+                shape_at_shifts(kind, tpl_best, jnp.asarray(centers), jnp.asarray([phi_best]))
             )[0]
             model_init = results["norm"][out_i] + np.asarray(
                 shape_at_shifts(kind, tpl, jnp.asarray(centers), jnp.asarray([0.0]))

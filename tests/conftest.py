@@ -9,7 +9,17 @@ multi-node testing).
 import os
 import pathlib
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# Force 8 virtual CPU devices. NOTE: a site hook may pre-import jax and
+# register an accelerator platform before this file runs, so setting env
+# vars alone is not enough — the platform choice must also go through
+# jax.config (effective as long as no backend client exists yet).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,133 @@
+"""Timing-model fitting tests: synthetic-recovery for MLE and MCMC.
+
+The reference ships no tests; these are injection/recovery properties on
+the delta-parameterized phase fit (reference fit_toas.py:284-457 with the
+full = base - delta convention of utilities_fittoas.py:151-157): ToAs
+generated as exact integer-rotation epochs of a TRUE model must, when fit
+starting from a perturbed BASE model, return the true parameters.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+jax = pytest.importorskip("jax")
+
+F0_TRUE = 0.15
+F1_TRUE = -1.0e-13
+PEPOCH = 58300.0
+
+
+def write_par(path, f0, f1, fit_f0=True, fit_f1=False):
+    lines = [
+        "PSR              J0000+0000",
+        f"F0     {f0!r} {'1' if fit_f0 else ''}".rstrip(),
+        f"F1  {f1!r} {'1' if fit_f1 else ''}".rstrip(),
+        f"PEPOCH\t {PEPOCH}",
+        "TRACK -2",
+    ]
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def synth_tim(path, par_true, n_toas=40, err_us=50.0, seed=4):
+    """ToAs at exact integer rotations of the true model (+ Gaussian noise)."""
+    from crimp_tpu.models import timing
+    from crimp_tpu.ops.ephem import integer_rotation_host
+
+    rng = np.random.RandomState(seed)
+    tm = timing.resolve(par_true)
+    grid = np.linspace(58100.0, 58500.0, n_toas)
+    anchors = integer_rotation_host(tm, grid)
+    toas = np.asarray(anchors["Tmjd_intRotation"], dtype=float)
+    toas = toas + rng.normal(0, err_us * 1e-6 / 86400.0, n_toas)
+    pns = np.asarray(np.round(anchors["ph_intRotation"]), dtype=int)
+    with open(path, "w") as fh:
+        fh.write("FORMAT 1\n")
+        for t, pn in zip(toas, pns):
+            fh.write(f" fake 300.0 {t:.13f} {err_us:.3f} @ -pn {pn}\n")
+    return str(path)
+
+
+@pytest.fixture()
+def fit_setup(tmp_path):
+    par_true = write_par(tmp_path / "true.par", F0_TRUE + 2.0e-9, F1_TRUE)
+    par_base = write_par(tmp_path / "base.par", F0_TRUE, F1_TRUE, fit_f0=True)
+    tim = synth_tim(tmp_path / "toas.tim", par_true)
+    return par_true, par_base, tim
+
+
+class TestMLE:
+    def test_recovers_injected_f0(self, fit_setup, tmp_path):
+        from crimp_tpu.io.parfile import get_parameter_value, read_timing_model
+        from crimp_tpu.pipelines.fit_toas import fit_toas
+
+        par_true, par_base, tim = fit_setup
+        out = str(tmp_path / "fit.par")
+        result = fit_toas(tim, par_base, out, residual_plot=str(tmp_path / "res"))
+        assert result["keys"] == ["F0"]
+        fitted = read_timing_model(out)[2]
+        f0_fit = get_parameter_value(fitted["F0"])
+        # injected offset is 2e-9 Hz; 50 us ToA noise over 400 d constrains
+        # F0 to ~1e-13, so recovery should be essentially exact
+        assert abs(f0_fit - (F0_TRUE + 2.0e-9)) < 2.0e-11
+        assert result["stats"]["redchi2"] < 2.0
+        assert (tmp_path / "res.pdf").exists()
+
+    def test_patched_par_has_statistics(self, fit_setup, tmp_path):
+        from crimp_tpu.pipelines.fit_toas import fit_toas
+
+        _, par_base, tim = fit_setup
+        out = str(tmp_path / "fit.par")
+        fit_toas(tim, par_base, out)
+        text = open(out).read()
+        for key in ("CHI2R", "NTOA", "TRES", "START", "FINISH"):
+            assert key in text
+
+    def test_two_parameter_fit(self, tmp_path):
+        from crimp_tpu.io.parfile import get_parameter_value, read_timing_model
+        from crimp_tpu.pipelines.fit_toas import fit_toas
+
+        par_true = write_par(tmp_path / "true.par", F0_TRUE + 1.0e-9, F1_TRUE - 5e-16)
+        par_base = write_par(tmp_path / "base.par", F0_TRUE, F1_TRUE, fit_f0=True, fit_f1=True)
+        tim = synth_tim(tmp_path / "toas.tim", par_true, n_toas=60)
+        out = str(tmp_path / "fit.par")
+        result = fit_toas(tim, par_base, out)
+        assert set(result["keys"]) == {"F0", "F1"}
+        fitted = read_timing_model(out)[2]
+        assert abs(get_parameter_value(fitted["F0"]) - (F0_TRUE + 1.0e-9)) < 5e-11
+        assert abs(get_parameter_value(fitted["F1"]) - (F1_TRUE - 5e-16)) < 5e-16
+
+
+class TestMCMC:
+    def test_posterior_covers_truth(self, fit_setup, tmp_path):
+        from crimp_tpu.io.parfile import get_parameter_value, read_timing_model
+        from crimp_tpu.pipelines.fit_toas import fit_toas
+
+        par_true, par_base, tim = fit_setup
+        yaml_path = tmp_path / "prior.yaml"
+        # bounds are on the DELTA (base - full), so center on zero
+        yaml_path.write_text("F0: [-1.0e-8, 1.0e-8]\n")
+        out = str(tmp_path / "fit_mcmc.par")
+        result = fit_toas(
+            tim, par_base, out, mcmc=True, mcmc_steps=600, mcmc_burn=150,
+            mcmc_walkers=16, init_yaml=str(yaml_path),
+            corner_plot_path=str(tmp_path / "corner"),
+        )
+        fitted = read_timing_model(out)[2]
+        f0_fit = get_parameter_value(fitted["F0"])
+        assert abs(f0_fit - (F0_TRUE + 2.0e-9)) < 5.0e-11
+        assert (tmp_path / "corner.pdf").exists()
+        # the patched par carries the posterior uncertainty column
+        assert "F0" in open(out).read()
+
+
+class TestPhaseWrap:
+    def test_add_phasewrap_shifts_later_toas(self):
+        from crimp_tpu.pipelines.fit_toas import add_phasewrap
+
+        df = pd.DataFrame({"ToA": [58100.0, 58200.0, 58300.0], "phase": [0.0, 0.0, 0.0]})
+        out = add_phasewrap(df.copy(), [58150.0], mode="add")
+        np.testing.assert_allclose(out["phase"], [0.0, 1.0, 1.0])
+        out = add_phasewrap(df.copy(), [58150.0, 58250.0], mode="subtract")
+        np.testing.assert_allclose(out["phase"], [0.0, -1.0, -2.0])

@@ -198,3 +198,49 @@ class TestFits:
         np.testing.assert_array_equal(
             back["EVENTS"].column("PI"), fitsio.read_fits(FITS)["EVENTS"].column("PI")
         )
+
+
+class TestNativeIO:
+    """The C++ event-I/O runtime must agree with the astropy path (and the
+    callers must fall back cleanly when it is unavailable)."""
+
+    def test_read_columns_matches_python_reader(self):
+        """C++ mmap reader vs the independent pure-Python FITS parser."""
+        from crimp_tpu.io import fitsio, native
+        from tests.conftest import FITS
+
+        cols = native.read_columns(FITS, "EVENTS", ["TIME", "PI"])
+        if cols is None:
+            pytest.skip("native crimpio unavailable in this environment")
+        events = fitsio.read_fits(FITS)["EVENTS"]
+        np.testing.assert_array_equal(
+            cols["TIME"], np.asarray(events.column("TIME"), dtype=np.float64)
+        )
+        np.testing.assert_array_equal(
+            cols["PI"], np.asarray(events.column("PI"), dtype=np.float64)
+        )
+
+    def test_filter_energy_matches_numpy(self):
+        from crimp_tpu.io import native
+
+        if native.load() is None:
+            pytest.skip("native crimpio unavailable in this environment")
+        rng = np.random.RandomState(0)
+        t = np.sort(rng.uniform(0, 1000, 5000))
+        pi = rng.uniform(0, 1500, 5000)
+        got = native.filter_energy(t, pi, 0.01, 0.0, 1.0, 5.0)
+        kev = pi * 0.01
+        keep = (kev >= 1.0) & (kev <= 5.0)
+        np.testing.assert_allclose(got[0], t[keep])
+        np.testing.assert_allclose(got[1], kev[keep])
+
+    def test_phase_histogram_matches_numpy(self):
+        from crimp_tpu.io import native
+
+        if native.load() is None:
+            pytest.skip("native crimpio unavailable in this environment")
+        rng = np.random.RandomState(1)
+        ph = rng.uniform(0, 1, 20000)
+        counts = native.phase_histogram(ph, 1.0, 32)
+        ref, _ = np.histogram(ph, bins=32, range=(0.0, 1.0))
+        np.testing.assert_array_equal(counts, ref)

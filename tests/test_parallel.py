@@ -1,0 +1,145 @@
+"""Multi-chip sharding tests on a virtual 8-device CPU mesh.
+
+The reference has no distributed layer (SURVEY.md §2.4); the TPU build's
+communication backend is XLA collectives over a Mesh, and its correctness
+contract is MESH-SHAPE INVARIANCE: statistics must not depend on how events
+or trials are sharded. conftest.py forces 8 virtual CPU devices
+(xla_force_host_platform_device_count), the prescribed stand-in for
+multi-node testing (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from crimp_tpu.ops import search  # noqa: E402
+from crimp_tpu.parallel import mesh as pmesh  # noqa: E402
+
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices (see conftest)"
+)
+
+
+@pytest.fixture(scope="module")
+def events():
+    rng = np.random.RandomState(0)
+    # pulsed events at 0.1432 Hz + unpulsed background, ~1 day span
+    n = 20000
+    base = rng.uniform(0, 86400.0, n)
+    pulsed = rng.rand(n) < 0.3
+    phase = rng.vonmises(0.0, 2.0, n) / (2 * np.pi)
+    times = np.where(pulsed, (np.round(base * 0.1432) + phase) / 0.1432, base)
+    times = np.sort(times)
+    return times - times.mean()
+
+
+@pytest.fixture(scope="module")
+def freqs():
+    return np.linspace(0.14315, 0.14325, 193)  # deliberately not a multiple of 8
+
+
+class TestMeshInvariance:
+    def test_z2_matches_single_device_f64_exact(self, events, freqs):
+        """In the f64 parity mode the sharded statistic is bit-level exact
+        to the single-device one (no f32 accumulation-order noise)."""
+        expected = np.asarray(
+            search.z2_power(jnp.asarray(events), jnp.asarray(freqs), 2, trig_dtype=jnp.float64)
+        )
+        for ev_par in (1, 2, 4, 8):
+            mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=ev_par)
+            got = pmesh.z2_sharded(events, freqs, nharm=2, mesh=mesh, trig_dtype=jnp.float64)
+            np.testing.assert_allclose(got, expected, rtol=1e-12, atol=1e-9)
+
+    def test_z2_matches_single_device_f32_fast_path(self, events, freqs):
+        """The f32-trig fast path agrees to well below the sqrt(N)
+        statistical noise of the statistic (~1e-6 relative rounding)."""
+        expected = np.asarray(search.z2_power(jnp.asarray(events), jnp.asarray(freqs), 2))
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=4)
+        got = pmesh.z2_sharded(events, freqs, nharm=2, mesh=mesh)
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-3)
+
+    def test_h_matches_single_device(self, events, freqs):
+        expected = np.asarray(
+            search.h_power(jnp.asarray(events), jnp.asarray(freqs[:48]), 10, trig_dtype=jnp.float64)
+        )
+        for ev_par in (2, 8):
+            mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=ev_par)
+            got = pmesh.h_sharded(events, freqs[:48], nharm=10, mesh=mesh, trig_dtype=jnp.float64)
+            np.testing.assert_allclose(got, expected, rtol=1e-12, atol=1e-9)
+
+    def test_mesh_shapes_agree_with_each_other(self, events, freqs):
+        results = []
+        for ev_par in (1, 2, 4, 8):
+            mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=ev_par)
+            results.append(
+                pmesh.z2_sharded(events, freqs, nharm=3, mesh=mesh, trig_dtype=jnp.float64)
+            )
+        for r in results[1:]:
+            np.testing.assert_allclose(r, results[0], rtol=1e-12, atol=1e-9)
+
+    def test_detects_injected_signal(self, events):
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=4)
+        freqs = np.linspace(0.1422, 0.1442, 401)
+        power = pmesh.z2_sharded(events, freqs, nharm=2, mesh=mesh)
+        assert abs(freqs[int(np.argmax(power))] - 0.1432) < 2e-4
+
+
+class TestShardedToABatch:
+    def test_sharded_segments_match_unsharded(self):
+        from crimp_tpu.models import profiles
+        from crimp_tpu.ops import toafit
+
+        rng = np.random.RandomState(1)
+        tpl = profiles.ProfileParams(
+            norm=jnp.asarray(10.0),
+            amp=jnp.asarray([3.0]),
+            loc=jnp.asarray([0.3]),
+            wid=jnp.zeros(1),
+            ph_shift=jnp.asarray(0.0),
+            amp_shift=jnp.asarray(1.0),
+        )
+        n_seg, n_ev = 8, 512
+        phases = np.empty((n_seg, n_ev))
+        for s in range(n_seg):
+            acc = np.empty(0)
+            while acc.size < n_ev:
+                cand = rng.uniform(0, 1, 4 * n_ev)
+                rate = 10.0 + 3.0 * np.cos(2 * np.pi * cand + 0.3)
+                keep = rng.uniform(0, rate.max() * 1.02, cand.size) < rate
+                acc = np.concatenate([acc, cand[keep]])
+            phases[s] = acc[:n_ev]
+        masks = np.ones_like(phases, dtype=bool)
+        exposures = np.full(n_seg, n_ev / 10.0)
+        cfg = toafit.ToAFitConfig(ph_shift_res=200, n_brute=64, refine_iters=25)
+
+        plain = toafit.fit_toas_batch(
+            "fourier", tpl, jnp.asarray(phases), jnp.asarray(masks),
+            jnp.asarray(exposures), cfg,
+        )
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=2)
+        sharded = toafit.fit_toas_batch(
+            "fourier", tpl,
+            pmesh.shard_segments(phases, mesh),
+            pmesh.shard_segments(masks, mesh),
+            pmesh.shard_segments(exposures, mesh),
+            cfg,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sharded["phShift"]), np.asarray(plain["phShift"]), atol=1e-9
+        )
+
+
+class TestDryrun:
+    def test_driver_dryrun_8(self):
+        import importlib.util
+        import pathlib
+
+        spec = importlib.util.spec_from_file_location(
+            "graft_entry", pathlib.Path(__file__).parent.parent / "__graft_entry__.py"
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.dryrun_multichip(8)

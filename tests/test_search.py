@@ -130,3 +130,66 @@ class TestZ2TwoD:
         rows, _ = ps.twod_ztest(np.array([-10.0, -9.0, -8.0]))
         best = rows[np.argmax(rows[:, 2])]
         assert best[1] == pytest.approx(-9.0)
+
+
+class TestUniformGridFastPath:
+    def test_uniform_grid_detection(self):
+        assert search.uniform_grid(np.linspace(0.1, 0.2, 1001)) is not None
+        f0, df = search.uniform_grid(np.linspace(0.1, 0.2, 1001))
+        assert abs(f0 - 0.1) < 1e-15 and abs(df - 1e-4) < 1e-12
+        assert search.uniform_grid(np.array([0.1, 0.2, 0.4])) is None
+        assert search.uniform_grid(np.array([0.1, 0.1, 0.1])) is None
+
+    def test_matches_general_path(self, sim_events):
+        """The f64-lean grid kernel agrees with the general f64-phase kernel
+        to well below the statistic's sqrt(N) noise."""
+        import jax.numpy as jnp
+
+        sec = sim_events - sim_events.mean()
+        freqs = np.linspace(0.2495, 0.2505, 733)
+        general = np.asarray(
+            search.z2_power(jnp.asarray(sec), jnp.asarray(freqs), 3,
+                            trig_dtype=jnp.float64)
+        )
+        fast = np.asarray(search.z2_power_grid(sec, freqs[0],
+                                               float(freqs[1] - freqs[0]),
+                                               len(freqs), 3))
+        np.testing.assert_allclose(fast, general, rtol=2e-4, atol=2e-3)
+        assert abs(freqs[int(np.argmax(fast))] - 0.25) < 5e-5
+
+    def test_h_grid_matches(self, sim_events):
+        import jax.numpy as jnp
+
+        sec = sim_events - sim_events.mean()
+        freqs = np.linspace(0.2497, 0.2503, 197)
+        general = np.asarray(
+            search.h_power(jnp.asarray(sec), jnp.asarray(freqs), 8,
+                           trig_dtype=jnp.float64)
+        )
+        fast = np.asarray(
+            search.h_power_grid(sec, freqs[0], float(freqs[1] - freqs[0]), len(freqs), 8)
+        )
+        np.testing.assert_allclose(fast, general, rtol=2e-4, atol=2e-3)
+
+    def test_long_baseline_coarse_grid_accuracy(self):
+        """Worst case for the f32 inner sweep: multi-year baseline with a
+        coarse grid (df*t spans many cycles). The mod-1 pre-reduction must
+        keep the fast path accurate."""
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(3)
+        sec = np.sort(rng.uniform(-7.5e6, 7.5e6, 30000))
+        freqs = np.linspace(0.14, 0.15, 501)  # df = 2e-5 Hz, df*t ~ 150 cyc
+        general = np.asarray(
+            search.z2_power(jnp.asarray(sec), jnp.asarray(freqs), 2,
+                            trig_dtype=jnp.float64)
+        )
+        fast = np.asarray(
+            search.z2_power_grid(sec, freqs[0], float(freqs[1] - freqs[0]), len(freqs), 2)
+        )
+        np.testing.assert_allclose(fast, general, rtol=5e-3, atol=0.3)
+
+    def test_periodsearch_uses_fast_path(self, sim_events):
+        ps = search.PeriodSearch(sim_events, np.linspace(0.2495, 0.2505, 256), 2)
+        power = ps.ztest()
+        assert abs(ps.freq[int(np.argmax(power))] - 0.25) < 5e-5

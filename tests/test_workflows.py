@@ -1,0 +1,209 @@
+"""Workflow-tool tests: tim merging, phase-shift->tim conversion, local
+ephemerides, diagnostics dashboard, plotting registry, CLI smoke.
+
+Covers the reference tools merge_overlapping_timfiles.py, timfile.py:164-233,
+get_local_ephem.py, diagnoseToAs.py, plot_pps.py and the 12-script CLI
+surface (pyproject console scripts)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tests.conftest import FITS, PAR, TEMPLATE, TOAS_TIM, TOAS_TXT  # noqa: E402
+
+
+def write_tim(path, toas, pns, err_us=100.0):
+    with open(path, "w") as fh:
+        fh.write("FORMAT 1\n")
+        for t, pn in zip(toas, pns):
+            fh.write(f" fake 300.0 {t:.13f} {err_us:.3f} @ -pn {pn}\n")
+    return str(path)
+
+
+class TestMergeTim:
+    def test_merges_with_pn_shift(self, tmp_path):
+        """Second file's pulse numbers are re-anchored via the overlap ToA
+        (merge_overlapping_timfiles.py:109-190 semantics)."""
+        from crimp_tpu.pipelines.merge_tim import merge_tim_files
+
+        t1 = write_tim(tmp_path / "a.tim", [58100.0, 58110.0, 58120.0], [0, 100, 200])
+        # overlap at 58120 with a different pn zero-point (offset 1000)
+        t2 = write_tim(tmp_path / "b.tim", [58120.0, 58130.0, 58140.0], [1200, 1300, 1400])
+        merged = merge_tim_files([t1, t2])
+        assert len(merged) == 5  # overlap deduplicated
+        pns = merged["pn"].to_numpy(dtype=float)
+        np.testing.assert_allclose(pns, [0, 100, 200, 300, 400])
+
+    def test_conflicting_overlap_raises(self, tmp_path):
+        from crimp_tpu.pipelines.merge_tim import merge_tim_files
+
+        t1 = write_tim(tmp_path / "a.tim", [58100.0, 58120.0, 58121.0], [0, 200, 210])
+        # two overlapping ToAs implying inconsistent shifts
+        t2 = write_tim(tmp_path / "b.tim", [58120.0, 58121.0, 58140.0], [1200, 1215, 1400])
+        with pytest.raises(Exception):
+            merge_tim_files([t1, t2])
+
+    def test_roundtrip_write(self, tmp_path):
+        from crimp_tpu.io.tim import read_tim
+        from crimp_tpu.pipelines.merge_tim import merge_tim_files, write_merged_tim
+
+        t1 = write_tim(tmp_path / "a.tim", [58100.0, 58110.0], [0, 100])
+        t2 = write_tim(tmp_path / "b.tim", [58110.0, 58125.0], [600, 750])
+        merged = merge_tim_files([t1, t2])
+        out = tmp_path / "merged"
+        write_merged_tim(merged, str(out), clobber=True)
+        back = read_tim(str(out) + ".tim")
+        assert len(back) == 3
+
+
+class TestPhshiftToTim:
+    def test_produces_tim_near_committed(self, tmp_path):
+        """Convert the committed ToA table and compare the first ToA to the
+        committed .tim oracle (BASELINE.md: 58136.13012457407 MJD)."""
+        from crimp_tpu.io.tim import read_tim
+        from crimp_tpu.pipelines.tim_tools import phshift_to_timfile
+
+        out = tmp_path / "out"
+        phshift_to_timfile(TOAS_TXT, PAR, str(out), tempModPP=TEMPLATE)
+        produced = read_tim(str(out) + ".tim")
+        committed = read_tim(TOAS_TIM)
+        assert len(produced) == len(committed)
+        t_new = produced["pulse_ToA"].to_numpy(float)
+        t_ref = committed["pulse_ToA"].to_numpy(float)
+        # < 1 us agreement on every ToA (north-star tolerance)
+        np.testing.assert_allclose(t_new, t_ref, rtol=0, atol=1.2e-11)
+        err_new = produced["pulse_ToA_err"].to_numpy(float)
+        err_ref = committed["pulse_ToA_err"].to_numpy(float)
+        np.testing.assert_allclose(err_new, err_ref, rtol=1e-6)
+
+
+class TestLocalEphem:
+    def test_windows_recover_global_f0(self, tmp_path, monkeypatch):
+        from crimp_tpu.ops.ephem import integer_rotation_host
+        from crimp_tpu.models import timing
+        from crimp_tpu.pipelines.local_ephem import generate_local_ephemerides
+
+        # synthetic integer-rotation ToAs from the bundled par
+        tm = timing.resolve(PAR)
+        rng = np.random.RandomState(2)
+        grid = np.linspace(58150.0, 58450.0, 60)
+        anchors = integer_rotation_host(tm, grid)
+        toas = np.asarray(anchors["Tmjd_intRotation"]) + rng.normal(0, 5e-4 / 86400, 60)
+        pns = np.round(np.asarray(anchors["ph_intRotation"])).astype(int)
+        tim = write_tim(tmp_path / "le.tim", toas, pns, err_us=500.0)
+
+        monkeypatch.chdir(tmp_path)
+        table = generate_local_ephemerides(
+            tim, PAR, interval_days=120.0, jump_days=60.0, min_interval=45.0,
+            outputfile=str(tmp_path / "locephem"), mcmc_steps=400, mcmc_burn=100,
+            mcmc_walkers=16,
+        )
+        assert len(table) >= 2
+        # The detrend removes only the global F0+F1 trend (reference
+        # get_local_ephem.py:247-249), so with F2 != 0 in the bundled par the
+        # expected residual is the quadratic term F2*dt^2/2.
+        from crimp_tpu.io.parfile import read_timing_model
+
+        vals = read_timing_model(PAR)[0]
+        dt = (table["TOA_MJD_ref"].to_numpy() - vals["PEPOCH"]) * 86400.0
+        expected = vals["F2"] * dt**2 / 2.0
+        resid = table["F0"].to_numpy() - expected
+        assert np.all(np.abs(resid) < 6 * table["F0_err"].to_numpy() + 2e-10)
+        assert (tmp_path / "locephem.txt").exists()
+
+    def test_plot_local_ephem(self, tmp_path):
+        from crimp_tpu.pipelines.plot_local_ephem import (
+            plot_local_ephemerides,
+            read_local_ephemerides,
+        )
+
+        df = pd.DataFrame(
+            {
+                "TOA_MJD_ref": [58200.0, 58300.0],
+                "TOA_MJD_ref_err": [45.0, 45.0],
+                "F0": [1e-8, -1e-8],
+                "F0_err": [5e-9, 5e-9],
+                "F1": [-1e-14, -1e-14],
+                "F1_err": [1e-15, 1e-15],
+                "CHI2R": [1.0, 1.1],
+                "DOF": [10, 12],
+            }
+        )
+        path = tmp_path / "le.txt"
+        df.to_csv(path, sep="\t", index=True)
+        back = read_local_ephemerides(str(path))
+        assert len(back) == 2
+        out = plot_local_ephemerides(back, glitches=[58250.0], plotname=str(tmp_path / "lep"))
+        assert (tmp_path / "lep.pdf").exists()
+
+
+class TestDiagnose:
+    def test_dashboard_from_committed_toas(self, tmp_path):
+        from crimp_tpu.pipelines.diagnose import diagnose_toas
+
+        out = tmp_path / "dash"
+        table = diagnose_toas(TOAS_TXT, outputFile=str(out))
+        assert len(table) == 84
+        assert (tmp_path / "dash.html").exists()
+
+
+class TestPlots:
+    def test_yaml_plot_registry(self, tmp_path):
+        import yaml
+
+        from crimp_tpu.pipelines.plots import prep_for_plotting, run_plots_from_yaml
+
+        df, gti = prep_for_plotting(FITS, PAR, enelow=1.0, enehigh=5.0)
+        cfg = {
+            "plots": [
+                {"type": "pp", "params": {"nbrbins": 32, "plotname": str(tmp_path / "pp")}},
+                {
+                    "type": "phase_energy",
+                    "params": {
+                        "nphasebins": 16, "nenergybins": 8,
+                        "plotname": str(tmp_path / "pe"),
+                    },
+                },
+            ]
+        }
+        cfg_path = tmp_path / "plots.yaml"
+        cfg_path.write_text(yaml.safe_dump(cfg))
+        run_plots_from_yaml(str(cfg_path), df)
+        assert (tmp_path / "pp.pdf").exists()
+        assert (tmp_path / "pe.pdf").exists()
+
+
+class TestCLISmoke:
+    """Every console script parses --help (the full 12-tool surface)."""
+
+    @pytest.mark.parametrize(
+        "tool",
+        [
+            "timeintervalsfortoas", "templatepulseprofile", "measuretoas",
+            "diagnosetoas", "addphasecolumn", "ephemintegerrotation",
+            "phshifttotimfile", "fittoas", "localephemerides",
+            "pulseprofile_plots", "localephemerides_plot", "mergeoverlappingtims",
+        ],
+    )
+    def test_help(self, tool, capsys):
+        from crimp_tpu import cli
+
+        with pytest.raises(SystemExit) as exc:
+            getattr(cli, tool)(["-h"])
+        assert exc.value.code == 0
+        assert capsys.readouterr().out.strip()
+
+    def test_ephemintegerrotation_runs(self, capsys):
+        from crimp_tpu import cli
+
+        cli.ephemintegerrotation(["58300.0", PAR, "-po"])
+        out = capsys.readouterr().out
+        assert "integer" in out.lower() or out.strip()
+
+    def test_diagnosetoas_runs(self, tmp_path):
+        from crimp_tpu import cli
+
+        cli.diagnosetoas([TOAS_TXT, "-of", str(tmp_path / "d")])
+        assert (tmp_path / "d.html").exists()
