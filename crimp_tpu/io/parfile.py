@@ -221,6 +221,19 @@ class ReadTimingModel:
 _FLOAT_RE = re.compile(r"^[+-]?(?:\d+(?:\.\d*)?|\.\d+)(?:[eEdD][+-]?\d+)?$")
 
 
+def add_pntrack_parfile(pardict: dict, parfile: str) -> None:
+    """Attach TRACK to ``pardict`` when the .par carries TRACK -2
+    (pulse-number tracking; reference readtimingmodel.py:324-332). Handles
+    both dict-of-dicts (value/flag) and plain-value dictionaries in place.
+    """
+    track = read_miscellaneous(parfile).get("TRACK")
+    if track == -2:
+        if pardict and isinstance(next(iter(pardict.values())), dict):
+            pardict["TRACK"] = {"value": track, "flag": 0}
+        else:
+            pardict["TRACK"] = track
+
+
 def _split_preserving(line: str) -> list[str]:
     """Split a line into alternating whitespace/token chunks (lossless)."""
     return re.findall(r"\s+|\S+", line)

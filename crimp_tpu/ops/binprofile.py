@@ -26,8 +26,16 @@ def bin_phases(phases: np.ndarray, nbrBins: int = 15) -> dict:
 
     half_bin = (upper / nbrBins) / 2
     centers = np.linspace(0, upper, nbrBins, endpoint=False) + half_bin
-    edges = np.linspace(0, upper, nbrBins + 1, endpoint=True)
-    counts = np.histogram(phases, bins=edges)[0]
+    counts = None
+    if phases.size >= 1_000_000:
+        # large arrays: the C++ single-pass histogram (native/crimpio.cpp)
+        # avoids numpy's edge binary-search; falls through when unavailable
+        from crimp_tpu.io import native
+
+        counts = native.phase_histogram(phases, upper, nbrBins)
+    if counts is None:
+        edges = np.linspace(0, upper, nbrBins + 1, endpoint=True)
+        counts = np.histogram(phases, bins=edges)[0]
     return {
         "ppBins": centers,
         "ppBinsRange": half_bin,

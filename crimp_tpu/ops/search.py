@@ -212,6 +212,7 @@ def harmonic_sums_uniform(
     nharm: int,
     event_block: int = GRID_EVENT_BLOCK,
     trial_block: int = GRID_TRIAL_BLOCK,
+    fdot: float | jax.Array = 0.0,
 ):
     """Trig sums over the uniform grid f0 + j*df — the f64-lean fast path.
 
@@ -242,7 +243,9 @@ def harmonic_sums_uniform(
 
         def step(carry, blk):
             t_blk, w_blk, b_blk = blk
-            base = f_tile * t_blk  # f64: one row per tile
+            # f64: one row per tile; the fdot term rides the same row (it is
+            # frequency-independent, so the j_lo sweep is untouched by it)
+            base = f_tile * t_blk + (0.5 * fdot) * t_blk**2
             cb = (base - jnp.round(base)).astype(jnp.float32)
             phase32 = cb[None, :] + j_lo[:, None] * b_blk[None, :]
             c, s = _harmonic_sums_cycles(phase32, w_blk[None, :].astype(jnp.float32), nharm, jnp.float32)
@@ -292,6 +295,35 @@ def h_power_grid(
     z2_cum = jnp.cumsum(z2_from_sums(c, s, np.shape(times)[0]), axis=0)
     penalties = 4.0 * jnp.arange(nharm, dtype=jnp.float64)[:, None]
     return jnp.max(z2_cum - penalties, axis=0)
+
+
+@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block", "trial_block"))
+def z2_power_2d_grid(
+    times: jax.Array,
+    f0: float,
+    df: float,
+    n_freq: int,
+    fdots: jax.Array,
+    nharm: int = 2,
+    event_block: int = GRID_EVENT_BLOCK,
+    trial_block: int = GRID_TRIAL_BLOCK,
+) -> jax.Array:
+    """Z^2_n over the (fdot x uniform-frequency) grid -> (n_fdot, n_freq).
+
+    Each fdot reuses the uniform-grid fast path with the quadratic term
+    folded into the per-tile f64 row (it is frequency-independent), so the
+    2-D scan inherits the same (trial_block-1)/trial_block f64 saving.
+    ``fdots`` are SIGNED Hz/s as in z2_power_2d.
+    """
+    n = times.shape[0]
+
+    def one_fdot(fd):
+        c, s = harmonic_sums_uniform(
+            times, f0, df, n_freq, nharm, event_block, trial_block, fdot=fd
+        )
+        return jnp.sum(z2_from_sums(c, s, n), axis=0)
+
+    return jax.lax.map(one_fdot, jnp.asarray(fdots, dtype=jnp.float64))
 
 
 @partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype"))
@@ -386,14 +418,24 @@ class PeriodSearch:
         """
         log_fdots = np.asarray(freq_dot, dtype=np.float64)
         signed = -(10.0**log_fdots)
-        power = np.asarray(
-            z2_power_2d(
-                self._centered(),
-                jnp.asarray(self.freq),
-                jnp.asarray(signed),
-                self.nbrHarm,
+        grid = uniform_grid(self.freq)
+        if grid is not None:
+            f0, df = grid
+            power = np.asarray(
+                z2_power_2d_grid(
+                    self._centered(), f0, df, len(self.freq),
+                    jnp.asarray(signed), self.nbrHarm,
+                )
             )
-        )
+        else:
+            power = np.asarray(
+                z2_power_2d(
+                    self._centered(),
+                    jnp.asarray(self.freq),
+                    jnp.asarray(signed),
+                    self.nbrHarm,
+                )
+            )
         rows = np.column_stack(
             [
                 np.tile(self.freq, len(log_fdots)),

@@ -310,8 +310,12 @@ def free_param_spec(kind: str, template: dict, vary_amps: bool = False):
                 lo.append(0.0)
                 hi.append(1000.0)
             else:
-                lo.append(0.0)
-                hi.append(5 * value(f"amp_{k}"))
+                # reference bound is [0, 5*amp], which degenerates for a
+                # negative amplitude — order the endpoints so the box stays
+                # valid either way
+                five = 5 * value(f"amp_{k}")
+                lo.append(min(0.0, five))
+                hi.append(max(0.0, five))
             n_free += 1
         loc_key = f"ph_{k}" if kind == FOURIER else f"cen_{k}"
         if varies(loc_key):

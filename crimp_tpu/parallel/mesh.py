@@ -72,26 +72,13 @@ def _pad_to(x: np.ndarray, multiple: int, fill=0.0):
     return out, weights
 
 
-@partial(jax.jit, static_argnames=("nharm", "mesh", "trig_dtype"))
 def _sharded_sums(times, weights, freqs, nharm: int, mesh: Mesh, trig_dtype=None):
-    """Per-harmonic trig sums with events sharded + psum-reduced."""
-    from crimp_tpu.ops.search import DEFAULT_TRIG_DTYPE
-
-    dtype = DEFAULT_TRIG_DTYPE if trig_dtype is None else trig_dtype
-
-    def kernel(t_shard, w_shard, f_shard):
-        phase = f_shard[:, None] * t_shard[None, :]  # cycles, f64
-        c, s = _harmonic_sums_cycles(phase, w_shard[None, :], nharm, dtype)
-        c = jax.lax.psum(c, EVENT_AXIS)
-        s = jax.lax.psum(s, EVENT_AXIS)
-        return c, s
-
-    return shard_map(
-        kernel,
-        mesh=mesh,
-        in_specs=(P(EVENT_AXIS), P(EVENT_AXIS), P(TRIAL_AXIS)),
-        out_specs=(P(None, TRIAL_AXIS), P(None, TRIAL_AXIS)),
-    )(times, weights, freqs)
+    """Per-harmonic trig sums with events sharded + psum-reduced
+    (the fdot = 0 slice of the 2-D kernel)."""
+    c, s = _sharded_sums_2d(
+        times, weights, freqs, jnp.zeros(1), nharm, mesh, trig_dtype
+    )
+    return c[0], s[0]
 
 
 def z2_sharded(times, freqs, nharm: int = 2, mesh: Mesh | None = None, trig_dtype=None) -> np.ndarray:
@@ -125,6 +112,53 @@ def h_sharded(times, freqs, nharm: int = 20, mesh: Mesh | None = None, trig_dtyp
     z2_cum = jnp.cumsum(z2_from_sums(c, s, n_events), axis=0)
     penalties = 4.0 * jnp.arange(nharm)[:, None]
     return np.asarray(jnp.max(z2_cum - penalties, axis=0))[: len(freqs)]
+
+
+@partial(jax.jit, static_argnames=("nharm", "mesh", "trig_dtype"))
+def _sharded_sums_2d(times, weights, freqs, fdots, nharm: int, mesh: Mesh, trig_dtype=None):
+    """Per-harmonic trig sums over the (fdot, freq) grid, events sharded."""
+    from crimp_tpu.ops.search import DEFAULT_TRIG_DTYPE
+
+    dtype = DEFAULT_TRIG_DTYPE if trig_dtype is None else trig_dtype
+
+    def kernel(t_shard, w_shard, f_shard, fd_all):
+        def one_fd(fd):
+            phase = (
+                f_shard[:, None] * t_shard[None, :]
+                + 0.5 * fd * t_shard[None, :] ** 2
+            )  # cycles, f64
+            c, s = _harmonic_sums_cycles(phase, w_shard[None, :], nharm, dtype)
+            return jax.lax.psum(c, EVENT_AXIS), jax.lax.psum(s, EVENT_AXIS)
+
+        return jax.lax.map(one_fd, fd_all)
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(EVENT_AXIS), P(EVENT_AXIS), P(TRIAL_AXIS), P(None)),
+        out_specs=(P(None, None, TRIAL_AXIS), P(None, None, TRIAL_AXIS)),
+    )(times, weights, freqs, fdots)
+
+
+def z2_2d_sharded(
+    times, freqs, fdots, nharm: int = 2, mesh: Mesh | None = None, trig_dtype=None
+) -> np.ndarray:
+    """Z^2_n over the (fdot, freq) grid -> (n_fdot, n_freq), events sharded
+    across the mesh with psum combines (fdots replicated; the frequency axis
+    shards over the trial mesh axis)."""
+    if mesh is None:
+        mesh = build_mesh()
+    n_events = len(times)
+    ev_size = mesh.shape[EVENT_AXIS]
+    tr_size = mesh.shape[TRIAL_AXIS]
+    t_pad, w_pad = _pad_to(np.asarray(times, dtype=np.float64), ev_size)
+    f_pad, _ = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
+    c, s = _sharded_sums_2d(
+        jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad),
+        jnp.asarray(fdots, dtype=np.float64), nharm, mesh, trig_dtype,
+    )
+    power = np.asarray(jnp.sum(z2_from_sums(c, s, n_events), axis=1))
+    return power[:, : len(freqs)]
 
 
 def shard_segments(array: np.ndarray, mesh: Mesh, axis_name: str = TRIAL_AXIS):

@@ -26,6 +26,7 @@ from crimp_tpu.models import profiles, timing
 from crimp_tpu.ops import anchored, search, toafit
 from crimp_tpu.ops.ephem import spin_frequency_host
 from crimp_tpu.utils.logging import get_logger
+from crimp_tpu.utils.profiling import timed, trace
 
 logger = get_logger(__name__)
 
@@ -104,9 +105,10 @@ def measure_toas(
     seg_sizes = [t.size for t in seg_times]
     anchor_idx = np.repeat(np.arange(len(seg_times)), seg_sizes)
     delta_all = anchored.anchor_deltas(np.concatenate(seg_times), toa_mids, anchor_idx)
-    folded_all = np.asarray(
-        anchored.anchored_fold(am, jnp.asarray(delta_all), jnp.asarray(anchor_idx))
-    )
+    with timed("anchored_fold"):
+        folded_all = np.asarray(
+            anchored.anchored_fold(am, jnp.asarray(delta_all), jnp.asarray(anchor_idx))
+        )
     seg_phase_list = list(np.split(folded_all, np.cumsum(seg_sizes)[:-1]))
 
     phases, masks = toafit.pad_segments(seg_phase_list)
@@ -148,10 +150,11 @@ def measure_toas(
             amp_hi=amp_hi,
         )
     exp_batch = exposures[toaStart:toaEnd].astype(float)
-    results = toafit.fit_toas_batch(
-        kind, tpl, phases, masks, exp_batch, cfg
-    )
-    results = {k: np.asarray(v) for k, v in results.items()}
+    with trace(), timed("toa_fit_batch"):
+        results = toafit.fit_toas_batch(
+            kind, tpl, phases, masks, exp_batch, cfg
+        )
+        results = {k: np.asarray(v) for k, v in results.items()}
 
     # ---- per-ToA H-test at the local ephemeris frequency -----------------
     freqs_mid, _ = spin_frequency_host(tm, toa_mids)
@@ -161,9 +164,10 @@ def measure_toas(
         centered = (t_seg - (t_seg[0] + t_seg[-1]) / 2) * 86400.0
         sec_padded[out_i, : t_seg.size] = centered
         sec_masks[out_i, : t_seg.size] = True
-    h_powers = np.asarray(
-        search.h_power_segments(sec_padded, sec_masks, freqs_mid, nharm=5)
-    )
+    with timed("per_toa_htest"):
+        h_powers = np.asarray(
+            search.h_power_segments(sec_padded, sec_masks, freqs_mid, nharm=5)
+        )
 
     # ---- outputs ---------------------------------------------------------
     with open(toaFile + ".txt", "w") as fh:

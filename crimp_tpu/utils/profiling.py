@@ -1,0 +1,77 @@
+"""Kernel timing + JAX profiler tracing.
+
+The reference has no tracing/profiling hooks at all (SURVEY.md §5: the only
+temporal record is log timestamps). The TPU framework exposes two layers:
+
+- ``timed(name)``: wall-clock a device call (forces completion — under some
+  PJRT transports ``block_until_ready`` returns early, so the timer
+  round-trips the result via ``np.asarray``) and log it;
+- ``trace(dir)``: a ``jax.profiler`` trace context for TensorBoard-level
+  kernel analysis, enabled by the CRIMP_TPU_TRACE_DIR environment variable
+  so production pipelines can be profiled without code changes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import numpy as np
+
+from crimp_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+_KERNEL_TIMES: dict[str, list[float]] = {}
+
+
+def force(result):
+    """Materialize a JAX value (or pytree leaf dict) on the host."""
+    if isinstance(result, dict):
+        return {k: force(v) for k, v in result.items()}
+    if isinstance(result, (list, tuple)):
+        return type(result)(force(v) for v in result)
+    try:
+        return np.asarray(result)
+    except TypeError:
+        return result
+
+
+@contextlib.contextmanager
+def timed(name: str, sync=None):
+    """Time a block; if ``sync`` is a callable it is invoked at exit to
+    force device completion (e.g. ``lambda: np.asarray(out)``)."""
+    t0 = time.perf_counter()
+    yield
+    if sync is not None:
+        force(sync() if callable(sync) else sync)
+    dt = time.perf_counter() - t0
+    _KERNEL_TIMES.setdefault(name, []).append(dt)
+    logger.info("[timing] %s: %.3fs", name, dt)
+
+
+def kernel_times() -> dict[str, list[float]]:
+    """All recorded block timings of this process (name -> durations)."""
+    return {k: list(v) for k, v in _KERNEL_TIMES.items()}
+
+
+def reset_kernel_times() -> None:
+    _KERNEL_TIMES.clear()
+
+
+@contextlib.contextmanager
+def trace(trace_dir: str | None = None):
+    """jax.profiler trace context; no-op when no directory is configured.
+
+    Directory resolution: explicit argument, else CRIMP_TPU_TRACE_DIR.
+    """
+    target = trace_dir or os.environ.get("CRIMP_TPU_TRACE_DIR")
+    if not target:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(target):
+        logger.info("[timing] jax profiler trace -> %s", target)
+        yield

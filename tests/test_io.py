@@ -244,3 +244,26 @@ class TestNativeIO:
         counts = native.phase_histogram(ph, 1.0, 32)
         ref, _ = np.histogram(ph, bins=32, range=(0.0, 1.0))
         np.testing.assert_array_equal(counts, ref)
+
+
+class TestAddPnTrack:
+    def test_attaches_track_minus_two(self, tmp_path):
+        from crimp_tpu.io.parfile import add_pntrack_parfile
+
+        par = tmp_path / "t.par"
+        par.write_text("PSR J0\nF0 0.1\nPEPOCH 58000\nTRACK -2\n")
+        plain = {"F0": 0.1}
+        add_pntrack_parfile(plain, str(par))
+        assert plain["TRACK"] == -2
+        nested = {"F0": {"value": 0.1, "flag": 1}}
+        add_pntrack_parfile(nested, str(par))
+        assert nested["TRACK"] == {"value": -2, "flag": 0}
+
+    def test_no_track_leaves_dict_alone(self, tmp_path):
+        from crimp_tpu.io.parfile import add_pntrack_parfile
+
+        par = tmp_path / "t.par"
+        par.write_text("PSR J0\nF0 0.1\nPEPOCH 58000\n")
+        d = {"F0": 0.1}
+        add_pntrack_parfile(d, str(par))
+        assert "TRACK" not in d

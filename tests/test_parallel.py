@@ -143,3 +143,22 @@ class TestDryrun:
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         mod.dryrun_multichip(8)
+
+
+class Test2DSharded:
+    def test_2d_matches_single_device(self, events, freqs):
+        import jax.numpy as jnp
+
+        from crimp_tpu.ops import search
+
+        fdots = np.array([-1e-13, 0.0])
+        expected = np.asarray(
+            search.z2_power_2d(jnp.asarray(events), jnp.asarray(freqs[:48]),
+                               jnp.asarray(fdots), 2, trig_dtype=jnp.float64)
+        )
+        for ev_par in (2, 8):
+            mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=ev_par)
+            got = pmesh.z2_2d_sharded(events, freqs[:48], fdots, nharm=2,
+                                      mesh=mesh, trig_dtype=jnp.float64)
+            assert got.shape == (2, 48)
+            np.testing.assert_allclose(got, expected, rtol=1e-12, atol=1e-9)

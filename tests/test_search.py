@@ -193,3 +193,31 @@ class TestUniformGridFastPath:
         ps = search.PeriodSearch(sim_events, np.linspace(0.2495, 0.2505, 256), 2)
         power = ps.ztest()
         assert abs(ps.freq[int(np.argmax(power))] - 0.25) < 5e-5
+
+
+class Test2DGridFastPath:
+    def test_matches_general_2d(self, sim_events):
+        import jax.numpy as jnp
+
+        sec = sim_events - sim_events.mean()
+        freqs = np.linspace(0.2496, 0.2504, 97)
+        fdots = np.array([-1e-12, -1e-11, 0.0])
+        general = np.asarray(
+            search.z2_power_2d(jnp.asarray(sec), jnp.asarray(freqs),
+                               jnp.asarray(fdots), 2, trig_dtype=jnp.float64)
+        )
+        fast = np.asarray(
+            search.z2_power_2d_grid(jnp.asarray(sec), freqs[0],
+                                    float(freqs[1] - freqs[0]), len(freqs),
+                                    jnp.asarray(fdots), 2)
+        )
+        assert fast.shape == (3, 97)
+        np.testing.assert_allclose(fast, general, rtol=2e-4, atol=2e-3)
+
+    def test_periodsearch_twod_uses_fast_path(self, sim_events):
+        ps = search.PeriodSearch(sim_events, np.linspace(0.2496, 0.2504, 64), 2)
+        rows, df = ps.twod_ztest(np.array([-12.0, -11.0]))
+        assert rows.shape == (128, 3)
+        # reference row ordering: outer fdot, inner freq
+        assert list(df.columns) == ["Freq", "Freq_dot", "Z2pow"]
+        assert np.allclose(df["Freq_dot"].to_numpy()[:64], -12.0)

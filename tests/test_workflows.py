@@ -207,3 +207,21 @@ class TestCLISmoke:
 
         cli.diagnosetoas([TOAS_TXT, "-of", str(tmp_path / "d")])
         assert (tmp_path / "d.html").exists()
+
+
+class TestProfiling:
+    def test_timed_records_and_logs(self):
+        from crimp_tpu.utils import profiling
+
+        profiling.reset_kernel_times()
+        with profiling.timed("unit_block", sync=lambda: np.arange(3)):
+            _ = sum(range(100))
+        times = profiling.kernel_times()
+        assert "unit_block" in times and times["unit_block"][0] >= 0
+
+    def test_trace_noop_without_dir(self, monkeypatch):
+        from crimp_tpu.utils import profiling
+
+        monkeypatch.delenv("CRIMP_TPU_TRACE_DIR", raising=False)
+        with profiling.trace():
+            pass  # must not require jax.profiler without a target dir
