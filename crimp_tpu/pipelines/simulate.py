@@ -67,3 +67,37 @@ def simulate_modulated_lc(
 
 # Reference-named alias (simulatemodulatedlc.py:19).
 simulatemodulatedlc = simulate_modulated_lc
+
+
+def main(argv=None):
+    """Module-level entry (parity with simulatemodulatedlc.py:99; the
+    reference does not register this as a console script either)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="Simulate a sinusoidally modulated event list"
+    )
+    parser.add_argument("freq", help="Signal frequency (Hz)", type=float)
+    parser.add_argument("-sr", "--srcrate", help="Source count rate (cts/s), default=1", type=float, default=1.0)
+    parser.add_argument("-ex", "--exposure", help="Exposure (s), default=10000", type=float, default=10000.0)
+    parser.add_argument("-pf", "--pulsedfraction", help="RMS pulsed fraction, default=0.2", type=float, default=0.2)
+    parser.add_argument("-bg", "--bgrrate", help="Background rate (cts/s), default=0.05", type=float, default=0.05)
+    parser.add_argument("-rs", "--resolution", help="Time resolution (s), default=0.073", type=float, default=0.073)
+    parser.add_argument("-nb", "--nbrPhaseBins", help="Phase bins (default: from resolution)", type=int, default=None)
+    parser.add_argument("-of", "--outputfile", help="Output .txt stem (time column)", type=str, default="simulatedlc")
+    args = parser.parse_args(argv)
+
+    sim = simulate_modulated_lc(
+        args.freq, args.srcrate, args.exposure, args.pulsedfraction, args.bgrrate,
+        args.resolution, args.nbrPhaseBins,
+    )
+    np.savetxt(args.outputfile + ".txt", sim["assigned_t_wBgr"])
+    print(
+        f"Simulated {len(sim['assigned_t_nobgr'])} source + "
+        f"{len(sim['assigned_t_wBgr']) - len(sim['assigned_t_nobgr'])} background events "
+        f"-> {args.outputfile}.txt"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -267,3 +267,45 @@ class TestAddPnTrack:
         d = {"F0": 0.1}
         add_pntrack_parfile(d, str(par))
         assert "TRACK" not in d
+
+
+class TestYamlPriors:
+    """YAML fit-prior loader consistency rules (utilities_fittoas.py:314-390)."""
+
+    def _load(self, tmp_path, text):
+        from crimp_tpu.io.yamlcfg import load_prior
+
+        p = tmp_path / "prior.yaml"
+        p.write_text(text)
+        return load_prior(str(p))
+
+    def test_bounds_and_guesses(self, tmp_path):
+        prior = self._load(
+            tmp_path,
+            "F0:\n  low: -1.0e-8\n  high: 1.0e-8\n  guess: 1.0e-9\n"
+            "F1:\n  low: -1.0e-15\n  high: 1.0e-15\n  guess: 0.0\n",
+        )
+        assert prior.bounds["F0"] == (-1e-8, 1e-8)
+        assert prior.initial_guess["F1"] == 0.0
+        assert prior.log_prior(np.array([0.0, 0.0]), ["F0", "F1"]) == 0.0
+        assert prior.log_prior(np.array([2e-8, 0.0]), ["F0", "F1"]) == -np.inf
+
+    def test_list_form_bounds(self, tmp_path):
+        prior = self._load(tmp_path, "F0: [-1.0e-8, 1.0e-8]\n")
+        assert prior.bounds["F0"] == (-1e-8, 1e-8)
+
+    def test_partial_bounds_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="missing"):
+            self._load(tmp_path, "F0: [-1, 1]\nF1: 0.5\n")
+
+    def test_partial_guesses_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            self._load(
+                tmp_path,
+                "F0:\n  low: -1\n  high: 1\n  guess: 0\n"
+                "F1:\n  low: -1\n  high: 1\n",
+            )
+
+    def test_inverted_bounds_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="low < high"):
+            self._load(tmp_path, "F0: [1.0, -1.0]\n")

@@ -1,0 +1,148 @@
+"""Per-mission event-file semantics on synthesized FITS files.
+
+The bundled data only covers NICER; these tests synthesize minimal event
+files for the other supported missions to pin down the per-telescope PI ->
+keV conversions (eventfile.py:251-271 semantics) and GTI extension
+resolution (XMM STDGTIxx by CCDSRC, eventfile.py:188-236).
+"""
+
+import numpy as np
+import pytest
+
+from crimp_tpu.io import fitsio
+from crimp_tpu.io.events import EventFile
+
+
+def _card(key, value, comment=""):
+    return fitsio._format_card(key, value, comment)
+
+
+def _bintable_bytes(name, columns, extra_cards=()):
+    """(header_bytes, data_bytes) for a simple BINTABLE extension."""
+    fields = []
+    tforms = []
+    for cname, values in columns:
+        values = np.asarray(values)
+        if values.dtype.kind == "f":
+            fields.append((cname, ">f8"))
+            tforms.append("D")
+        else:
+            fields.append((cname, ">i4"))
+            tforms.append("J")
+    rec = np.zeros(len(columns[0][1]), dtype=np.dtype(fields))
+    for cname, values in columns:
+        rec[cname] = values
+    cards = [
+        _card("XTENSION", "BINTABLE"),
+        _card("BITPIX", 8),
+        _card("NAXIS", 2),
+        _card("NAXIS1", rec.dtype.itemsize),
+        _card("NAXIS2", len(rec)),
+        _card("PCOUNT", 0),
+        _card("GCOUNT", 1),
+        _card("TFIELDS", len(columns)),
+    ]
+    for i, ((cname, _), tform) in enumerate(zip(columns, tforms), start=1):
+        cards.append(_card(f"TTYPE{i}", cname))
+        cards.append(_card(f"TFORM{i}", tform))
+    cards.append(_card("EXTNAME", name))
+    cards.extend(extra_cards)
+    return fitsio._serialize_header(cards) + fitsio._pad_block(rec.tobytes())
+
+
+def make_event_file(
+    path, telescope, pi_values, gti_extname="GTI", ccdsrc=None, energy_col="PI"
+):
+    """Minimal mission event file: primary + EVENTS + one GTI table."""
+    n = len(pi_values)
+    times = np.linspace(100.0, 4000.0, n)
+    mission_cards = [
+        _card("TELESCOP", telescope),
+        _card("INSTRUME", "SYNTH"),
+        _card("TSTART", 100.0),
+        _card("TSTOP", 4000.0),
+        _card("TIMESYS", "TDB"),
+        _card("MJDREFI", 56658),
+        _card("MJDREFF", 0.000777592592592593),
+    ]
+    if ccdsrc is not None:
+        mission_cards.append(_card("CCDSRC", ccdsrc))
+
+    primary = fitsio._serialize_header(
+        [_card("SIMPLE", True), _card("BITPIX", 8), _card("NAXIS", 0)]
+    )
+    events = _bintable_bytes(
+        "EVENTS",
+        [("TIME", times), (energy_col, np.asarray(pi_values))],
+        extra_cards=mission_cards,
+    )
+    gti = _bintable_bytes(
+        gti_extname,
+        [("START", np.array([100.0, 2000.0])), ("STOP", np.array([1500.0, 4000.0]))],
+        extra_cards=mission_cards,
+    )
+    with open(path, "wb") as fh:
+        fh.write(primary + events + gti)
+    return str(path)
+
+
+class TestMissionConversions:
+    @pytest.mark.parametrize(
+        "telescope,pi,expected_kev",
+        [
+            ("NICER", [100, 500], [1.0, 5.0]),  # x0.01
+            ("SWIFT", [100, 500], [1.0, 5.0]),  # x0.01
+            ("NuSTAR", [10, 110], [2.0, 6.0]),  # x0.04 + 1.6
+            ("XMM", [1000, 5000], [1.0, 5.0]),  # x0.001
+            ("IXPE", [50, 150], [2.0, 6.0]),  # x0.04
+        ],
+    )
+    def test_pi_to_kev(self, tmp_path, telescope, pi, expected_kev):
+        kwargs = {"ccdsrc": 3} if telescope == "XMM" else {}
+        gti_name = "STDGTI03" if telescope == "XMM" else "GTI"
+        path = make_event_file(
+            tmp_path / "evt.fits", telescope, pi, gti_extname=gti_name, **kwargs
+        )
+        ef = EventFile(path)
+        df = ef.build_time_energy_df().time_energy_df
+        np.testing.assert_allclose(df["PI"].to_numpy(), expected_kev)
+
+    def test_gbm_keeps_raw_pha(self, tmp_path):
+        path = make_event_file(
+            tmp_path / "evt.fits", "GLAST", [12, 80], energy_col="PHA"
+        )
+        ef = EventFile(path)
+        df = ef.build_time_energy_df().time_energy_df
+        assert "PHA" in df.columns
+        np.testing.assert_array_equal(df["PHA"].to_numpy(), [12, 80])
+
+    def test_unknown_telescope_raises(self, tmp_path):
+        path = make_event_file(tmp_path / "evt.fits", "CHANDRA-X", [10, 20])
+        with pytest.raises(ValueError, match="not supported"):
+            EventFile(path).read_gti()
+
+
+class TestGTIResolution:
+    def test_xmm_stdgti_by_ccdsrc(self, tmp_path):
+        path = make_event_file(
+            tmp_path / "evt.fits", "XMM", [1000, 2000],
+            gti_extname="STDGTI07", ccdsrc=7,
+        )
+        keywords, gti = EventFile(path).read_gti()
+        assert gti.shape == (2, 2)
+        # MJD conversion applied
+        assert 56658 < gti.min() < 56659
+
+    def test_xmm_two_digit_ccdsrc(self, tmp_path):
+        path = make_event_file(
+            tmp_path / "evt.fits", "XMM", [1000, 2000],
+            gti_extname="STDGTI12", ccdsrc=12,
+        )
+        _, gti = EventFile(path).read_gti()
+        assert gti.shape == (2, 2)
+
+    def test_standard_gti_for_others(self, tmp_path):
+        path = make_event_file(tmp_path / "evt.fits", "SWIFT", [100, 200])
+        keywords, gti = EventFile(path).read_gti()
+        assert keywords["TELESCOPE"] == "SWIFT"
+        assert (gti[:, 1] > gti[:, 0]).all()

@@ -225,3 +225,51 @@ class TestProfiling:
         monkeypatch.delenv("CRIMP_TPU_TRACE_DIR", raising=False)
         with profiling.trace():
             pass  # must not require jax.profiler without a target dir
+
+
+class TestAllPlotTypes:
+    def test_phase_time_grid_and_before_after(self, tmp_path):
+        from crimp_tpu.pipelines.plots import (
+            plotting_phase_time,
+            plotting_pp_before_after,
+            plotting_pp_grid,
+            prep_for_plotting,
+        )
+
+        df, gti = prep_for_plotting(FITS, PAR, enelow=1.0, enehigh=5.0)
+        mid = float(df["TIME"].median())
+        plotting_phase_time(df, nphasebins=16, ntimebins=6, plotname=str(tmp_path / "pt"))
+        plotting_pp_grid(
+            df, n_timebins=2, n_energybins=2, nbrbins=(10, 10),
+            plotname=str(tmp_path / "grid"),
+        )
+        plotting_pp_before_after(
+            df, t_mjd=mid, days_window=1.0, nbrbins=16,
+            plotname=str(tmp_path / "ba"),
+        )
+        for stem in ("pt", "grid", "ba"):
+            assert (tmp_path / f"{stem}.pdf").exists()
+
+
+class TestCLIEndToEnd:
+    def test_timeintervals_cli(self, tmp_path, monkeypatch):
+        from crimp_tpu import cli
+
+        monkeypatch.chdir(tmp_path)
+        cli.timeintervalsfortoas([
+            FITS, "-tc", "30000", "-el", "1", "-eh", "5",
+            "-of", str(tmp_path / "ints"),
+        ])
+        assert (tmp_path / "ints.txt").exists()
+        assert (tmp_path / "ints_bunches.txt").exists()
+
+    def test_templatepulseprofile_cli(self, tmp_path, monkeypatch):
+        from crimp_tpu import cli
+
+        monkeypatch.chdir(tmp_path)
+        cli.templatepulseprofile([
+            FITS, PAR, "-el", "1", "-eh", "5", "-nb", "70",
+            "-it", TEMPLATE, "-tf", str(tmp_path / "tpl"),
+        ])
+        out = (tmp_path / "tpl.txt").read_text()
+        assert "fourier" in out and "chi2" in out
