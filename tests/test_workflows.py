@@ -273,3 +273,25 @@ class TestCLIEndToEnd:
         ])
         out = (tmp_path / "tpl.txt").read_text()
         assert "fourier" in out and "chi2" in out
+
+
+class TestLogging:
+    def test_configure_logging_writes_truncated_file(self, tmp_path):
+        import logging
+
+        from crimp_tpu.utils.logging import configure_logging, get_logger, verbosity_to_level
+
+        log_path = tmp_path / "run.log"
+        log_path.write_text("stale content from a previous run\n")
+        configure_logging(file_path=str(log_path), force=True)
+        logger = get_logger("crimp_tpu.test")
+        logger.info("run parameters: alpha=1")
+        logging.shutdown()
+        text = log_path.read_text()
+        assert "stale content" not in text  # truncate-on-run
+        assert "run parameters: alpha=1" in text
+        assert verbosity_to_level(0) == "WARNING"
+        assert verbosity_to_level(1) == "INFO"
+        assert verbosity_to_level(5) == "DEBUG"
+        # reset handlers so later tests are unaffected
+        configure_logging(force=True)
