@@ -189,3 +189,40 @@ class TestWaveFit:
         # 2 ms ToA noise over 50 ToAs constrains ~ms-level wave amplitudes
         assert abs(fa - a1) < 5e-3
         assert abs(fb - b1) < 5e-3
+
+
+class TestYamlGuesses:
+    def test_yaml_guess_reaches_the_start_vector(self, fit_setup, tmp_path):
+        """extract_free_params consumes YAML initial guesses (delta space):
+        assert the guess IS the optimizer start vector (a converged end-to-
+        end fit would pass even with the guess dropped)."""
+        from crimp_tpu.io.parfile import read_timing_model
+        from crimp_tpu.pipelines import fit_utils
+
+        _, par_base, _ = fit_setup
+        yaml_path = tmp_path / "init.yaml"
+        yaml_path.write_text("F0:\n  guess: -2.0e-9\n")  # delta = base - full
+        base_dict = read_timing_model(par_base)[2]
+        p0, keys = fit_utils.extract_free_params(base_dict, str(yaml_path))
+        assert keys == ["F0"]
+        np.testing.assert_allclose(p0, [-2.0e-9], rtol=0, atol=0)
+
+        # and the full pipeline accepts the file end to end
+        from crimp_tpu.io.parfile import get_parameter_value
+        from crimp_tpu.pipelines.fit_toas import fit_toas
+
+        _, par_base2, tim = fit_setup
+        out = str(tmp_path / "fit.par")
+        fit_toas(tim, par_base2, out, init_yaml=str(yaml_path))
+        fitted = read_timing_model(out)[2]
+        assert abs(get_parameter_value(fitted["F0"]) - (F0_TRUE + 2.0e-9)) < 2e-11
+
+    def test_missing_guess_for_free_param_raises(self, fit_setup, tmp_path):
+        from crimp_tpu.pipelines.fit_toas import fit_toas
+
+        _, par_base, tim = fit_setup
+        # base par frees F0; YAML carries a guess only for F1
+        yaml_path = tmp_path / "init.yaml"
+        yaml_path.write_text("F1:\n  guess: 0.0\n")
+        with pytest.raises((ValueError, KeyError)):
+            fit_toas(tim, par_base, str(tmp_path / "f.par"), init_yaml=str(yaml_path))

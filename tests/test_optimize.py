@@ -1,0 +1,94 @@
+"""Optimizer-primitive tests: golden section, Nelder-Mead, bounds transform,
+and the binned template fit's vary-mask semantics."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from crimp_tpu.ops.optimize import bounded_transform, golden_section, nelder_mead  # noqa: E402
+
+
+class TestGoldenSection:
+    def test_finds_scalar_maximum(self):
+        x, f = golden_section(lambda x: -((x - 0.7) ** 2), jnp.asarray(0.0), jnp.asarray(2.0))
+        assert abs(float(x) - 0.7) < 1e-8
+        assert abs(float(f)) < 1e-12
+
+    def test_batched_independent_searches(self):
+        centers = jnp.asarray([0.2, 1.4, -0.5])
+        x, f = golden_section(
+            lambda x: -((x - centers) ** 2),
+            jnp.full(3, -2.0), jnp.full(3, 2.0),
+        )
+        np.testing.assert_allclose(np.asarray(x), [0.2, 1.4, -0.5], atol=1e-7)
+
+    def test_minimize_mode(self):
+        x, f = golden_section(
+            lambda x: (x - 1.0) ** 2, jnp.asarray(-3.0), jnp.asarray(3.0), maximize=False
+        )
+        assert abs(float(x) - 1.0) < 1e-7
+
+
+class TestNelderMead:
+    def test_rosenbrock_2d(self):
+        def rosen(v):
+            return (1 - v[0]) ** 2 + 100 * (v[1] - v[0] ** 2) ** 2
+
+        x, f = nelder_mead(rosen, jnp.asarray([-1.0, 1.0]), init_scale=0.5, iters=400)
+        np.testing.assert_allclose(np.asarray(x), [1.0, 1.0], atol=1e-3)
+
+    def test_vmappable(self):
+        def quad(v):
+            return jnp.sum((v - 3.0) ** 2)
+
+        starts = jnp.asarray([[0.0, 0.0], [5.0, 5.0], [-2.0, 4.0]])
+        xs, fs = jax.vmap(lambda s: nelder_mead(quad, s, iters=150))(starts)
+        np.testing.assert_allclose(np.asarray(xs), np.full((3, 2), 3.0), atol=1e-4)
+
+
+class TestBoundedTransform:
+    def test_roundtrip_and_range(self):
+        tf = bounded_transform(jnp.asarray([0.0, -1.0]), jnp.asarray([2.0, 1.0]))
+        x = jnp.asarray([0.3, 0.9])
+        np.testing.assert_allclose(np.asarray(tf.to_bounded(tf.to_unbounded(x))), np.asarray(x), atol=1e-9)
+        u = jnp.asarray([-50.0, 50.0])
+        b = np.asarray(tf.to_bounded(u))
+        assert b[0] >= 0.0 and b[1] <= 1.0
+
+    def test_out_of_range_start_clips_not_nan(self):
+        tf = bounded_transform(jnp.asarray([-np.pi]), jnp.asarray([np.pi]))
+        u = tf.to_unbounded(jnp.asarray([5.0]))  # outside [-pi, pi]
+        assert np.isfinite(np.asarray(u)).all()
+
+
+class TestTemplateFitVaryMask:
+    def test_frozen_parameters_stay_fixed(self):
+        from crimp_tpu.models.profiles import ProfileParams, curve
+        from crimp_tpu.ops.templatefit import fit_binned_template
+
+        rng = np.random.RandomState(2)
+        true = ProfileParams(
+            norm=jnp.asarray(12.0), amp=jnp.asarray([3.0, 1.0]),
+            loc=jnp.asarray([0.4, -0.6]), wid=jnp.zeros(2),
+            ph_shift=jnp.asarray(0.0), amp_shift=jnp.asarray(1.0),
+        )
+        bins = np.linspace(0.0125, 1.0, 40, endpoint=False)
+        rate = np.asarray(curve("fourier", true, jnp.asarray(bins)))
+        noisy = rate + rng.normal(0, 0.2, len(bins))
+        err = np.full(len(bins), 0.2)
+
+        init = true.replace(norm=jnp.asarray(10.0), amp=jnp.asarray([2.0, 1.0]))
+        # vary mask (flatten order: norm, amps, locs, wids): freeze amp_2+locs
+        vary = np.array([True, True, False, False, False, False, False, False])
+        best, model, stats = fit_binned_template(
+            "fourier", init, bins, noisy, err, vary=vary
+        )
+        # frozen entries keep their init values exactly
+        assert float(best.amp[1]) == 1.0
+        np.testing.assert_array_equal(np.asarray(best.loc), np.asarray(init.loc))
+        # free entries moved toward truth
+        assert abs(float(best.norm) - 12.0) < 0.2
+        assert abs(float(best.amp[0]) - 3.0) < 0.3
+        assert stats["dof"] == 40 - 2

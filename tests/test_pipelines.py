@@ -228,3 +228,20 @@ class TestDiagnosticPlots:
         )
         assert (tmp_path / "pp_ToA0.pdf").exists()
         assert (tmp_path / "LogL_ToA0.pdf").exists()
+
+
+class TestToASubrange:
+    def test_ts_te_resume_semantics(self, obs_intervals, tmp_path):
+        """-ts/-te ToA-index subrange (the reference's resume mechanism;
+        toaEnd is inclusive as in the CLI)."""
+        from crimp_tpu.pipelines.measure_toas import measure_toas
+
+        gti_path, df = obs_intervals
+        toas = measure_toas(
+            FITS, PAR, TEMPLATE, gti_path,
+            eneLow=1.0, eneHigh=5.0, phShiftRes=300,
+            toaStart=1, toaEnd=2,
+            toaFile=str(tmp_path / "ToAs_sub"),
+        )
+        assert list(toas["ToA"]) == [1, 2]
+        assert np.all(np.abs(toas["phShift"]) < 0.5)

@@ -281,17 +281,29 @@ class TestLogging:
 
         from crimp_tpu.utils.logging import configure_logging, get_logger, verbosity_to_level
 
-        log_path = tmp_path / "run.log"
-        log_path.write_text("stale content from a previous run\n")
-        configure_logging(file_path=str(log_path), force=True)
-        logger = get_logger("crimp_tpu.test")
-        logger.info("run parameters: alpha=1")
-        logging.shutdown()
-        text = log_path.read_text()
-        assert "stale content" not in text  # truncate-on-run
-        assert "run parameters: alpha=1" in text
-        assert verbosity_to_level(0) == "WARNING"
-        assert verbosity_to_level(1) == "INFO"
-        assert verbosity_to_level(5) == "DEBUG"
-        # reset handlers so later tests are unaffected
-        configure_logging(force=True)
+        root = logging.getLogger()
+        saved_handlers = root.handlers[:]
+        saved_level = root.level
+        try:
+            log_path = tmp_path / "run.log"
+            log_path.write_text("stale content from a previous run\n")
+            configure_logging(file_path=str(log_path), force=True)
+            logger = get_logger("crimp_tpu.test")
+            logger.info("run parameters: alpha=1")
+            for handler in logging.getLogger().handlers:
+                handler.flush()
+            text = log_path.read_text()
+            assert "stale content" not in text  # truncate-on-run
+            assert "run parameters: alpha=1" in text
+            assert verbosity_to_level(0) == "WARNING"
+            assert verbosity_to_level(1) == "INFO"
+            assert verbosity_to_level(5) == "DEBUG"
+        finally:
+            # restore the pre-test global logging state exactly
+            for handler in root.handlers[:]:
+                root.removeHandler(handler)
+                if handler not in saved_handlers:
+                    handler.close()
+            for handler in saved_handlers:
+                root.addHandler(handler)
+            root.setLevel(saved_level)
