@@ -108,7 +108,8 @@ def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np
         am = anchored.prepare_anchors(tm, toa_mids)
         seg_sizes = [t.size for t in seg_times]
         anchor_idx = np.repeat(np.arange(len(seg_times)), seg_sizes)
-        delta_all = anchored.anchor_deltas(np.concatenate(seg_times), toa_mids, anchor_idx)
+        all_times = np.concatenate(seg_times)
+        delta_all = anchored.anchor_deltas(all_times, toa_mids, anchor_idx)
         folded_all = np.asarray(
             anchored.anchored_fold(am, jnp.asarray(delta_all), jnp.asarray(anchor_idx))
         )
@@ -128,6 +129,35 @@ def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np
         return fit
 
     run_once()  # compile
+
+    # North-star check (outside the timed region): device fold vs the host
+    # longdouble reference, <1 us target. Frac extraction stays in
+    # longdouble so the comparison measures device error, not cast noise.
+    starts = intervals["ToA_tstart"].to_numpy()
+    ends = intervals["ToA_tend"].to_numpy()
+    toa_mids = np.zeros(len(intervals))
+    seg_times = []
+    for i in range(len(intervals)):
+        t_seg = times[(times >= starts[i]) & (times <= ends[i])]
+        toa_mids[i] = (t_seg[-1] - t_seg[0]) / 2 + t_seg[0]
+        seg_times.append(t_seg)
+    am = anchored.prepare_anchors(tm, toa_mids)
+    sizes = [t.size for t in seg_times]
+    anchor_idx = np.repeat(np.arange(len(seg_times)), sizes)
+    all_times = np.concatenate(seg_times)
+    deltas = anchored.anchor_deltas(all_times, toa_mids, anchor_idx)
+    folded = np.asarray(
+        anchored.anchored_fold(am, jnp.asarray(deltas), jnp.asarray(anchor_idx))
+    )
+    sample = slice(0, len(all_times), max(1, len(all_times) // 20000))
+    host_phase = anchored.host_total_phase(tm, all_times[sample])  # longdouble
+    host_frac = np.asarray(host_phase - np.floor(host_phase), dtype=np.float64)
+    diff = np.abs(folded[sample] - host_frac)
+    diff = np.minimum(diff, 1.0 - diff)  # wrap-around
+    f_typ = float(spin_frequency_host(tm, np.atleast_1d(toa_mids.mean()))[0][0])
+    log(f"[bench] device-vs-host fold max diff: {diff.max():.3e} cycles "
+        f"= {diff.max() / f_typ * 1e6:.4f} us (north star < 1 us)")
+
     t0 = time.perf_counter()
     fit = run_once()
     wall = time.perf_counter() - t0
