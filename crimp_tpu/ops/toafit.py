@@ -560,3 +560,57 @@ def pad_segments(phase_list: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray]:
         phases[i, : len(p)] = p
         masks[i, : len(p)] = True
     return phases, masks
+
+
+def fit_toas_bucketed(
+    kind: str,
+    tpl: ProfileParams,
+    phase_list: list[np.ndarray],
+    exposures: np.ndarray,
+    cfg: ToAFitConfig,
+    max_pad_ratio: float = 4.0,
+) -> dict:
+    """Batched ToA fit with SIZE-BUCKETED padding (host orchestration).
+
+    Pad-to-global-max wastes compute when segment event counts are
+    heterogeneous (a merged campaign can mix 1e3- and 1e5-event intervals:
+    padding everything to 1e5 inflates the likelihood sweeps ~100x for the
+    small segments). Segments are grouped into power-of-two size buckets
+    (consecutive buckets merged while the padding waste stays under
+    ``max_pad_ratio``), each bucket runs one ``fit_toas_batch`` compile/
+    execute, and results scatter back to the original order. Homogeneous
+    inputs collapse to a single bucket — identical to the plain path.
+    """
+    sizes = np.asarray([len(p) for p in phase_list])
+    if len(phase_list) == 0:
+        return {}
+    order = np.argsort(sizes, kind="stable")
+    # bucket boundaries: next power of two of each segment size
+    pow2 = 1 << np.ceil(np.log2(np.maximum(sizes[order], 1))).astype(int)
+    buckets: list[list[int]] = []
+    current: list[int] = []
+    current_cap = pow2[0]
+    for pos, seg_idx in enumerate(order):
+        cap = pow2[pos]
+        if current and cap > current_cap and cap > max_pad_ratio * sizes[current[0]]:
+            buckets.append(current)
+            current = []
+        current.append(int(seg_idx))
+        current_cap = cap
+    if current:
+        buckets.append(current)
+
+    exposures = np.asarray(exposures, dtype=float)
+    out: dict[str, np.ndarray] = {}
+    for bucket in buckets:
+        phases, masks = pad_segments([phase_list[i] for i in bucket])
+        res = fit_toas_batch(
+            kind, tpl, jnp.asarray(phases), jnp.asarray(masks),
+            jnp.asarray(exposures[bucket]), cfg,
+        )
+        for key, val in res.items():
+            arr = np.asarray(val)
+            if key not in out:
+                out[key] = np.zeros((len(phase_list),) + arr.shape[1:], dtype=arr.dtype)
+            out[key][bucket] = arr
+    return out

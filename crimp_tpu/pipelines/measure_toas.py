@@ -110,10 +110,11 @@ def measure_toas(
             anchored.anchored_fold(am, jnp.asarray(delta_all), jnp.asarray(anchor_idx))
         )
     seg_phase_list = list(np.split(folded_all, np.cumsum(seg_sizes)[:-1]))
+    if kind in (profiles.CAUCHY, profiles.VONMISES):
+        # radians convention for these families (measureToAs.py:195-200)
+        seg_phase_list = [p * (2 * np.pi) for p in seg_phase_list]
 
     phases, masks = toafit.pad_segments(seg_phase_list)
-    if kind in (profiles.CAUCHY, profiles.VONMISES):
-        phases = phases * (2 * np.pi)  # radians convention (measureToAs.py:195-200)
 
     if readvaryparam:
         # General path: free parameters follow the template 'vary' flags
@@ -150,11 +151,19 @@ def measure_toas(
             amp_hi=amp_hi,
         )
     exp_batch = exposures[toaStart:toaEnd].astype(float)
+    size_ratio = max(seg_sizes) / max(min(seg_sizes), 1)
     with trace(), timed("toa_fit_batch"):
-        results = toafit.fit_toas_batch(
-            kind, tpl, phases, masks, exp_batch, cfg
-        )
-        results = {k: np.asarray(v) for k, v in results.items()}
+        if size_ratio > 4.0:
+            # heterogeneous campaign: size-bucketed padding avoids inflating
+            # every likelihood sweep to the largest interval's event count
+            results = toafit.fit_toas_bucketed(
+                kind, tpl, seg_phase_list, exp_batch, cfg
+            )
+        else:
+            results = toafit.fit_toas_batch(
+                kind, tpl, phases, masks, exp_batch, cfg
+            )
+            results = {k: np.asarray(v) for k, v in results.items()}
 
     # ---- per-ToA H-test at the local ephemeris frequency -----------------
     freqs_mid, _ = spin_frequency_host(tm, toa_mids)

@@ -207,3 +207,44 @@ class TestVaryAmps:
         res = fit_one(kind, tpl, phases, exposure=9000 / expected_counts,
                       vary_amps=True, amp_lo=1e-6, amp_hi=500.0)
         assert abs(res["ampShift"] - injected_b) < 0.15
+
+
+class TestBucketedFit:
+    def test_matches_plain_batch_and_orders_results(self):
+        """Size-bucketed fits must reproduce the pad-to-max results in the
+        original segment order (heterogeneous sizes force >1 bucket)."""
+        rng = np.random.RandomState(31)
+        kind = profiles.FOURIER
+        tpl = template(kind)
+        sizes = [300, 4000, 350, 3800, 5000]
+        shifts = [-0.4, 0.1, 0.5, -0.1, 0.3]
+        segs = [draw_phases(kind, tpl, n, rng, ph_shift=s) for n, s in zip(sizes, shifts)]
+        exps = np.asarray([n / 17.0 for n in sizes])
+        cfg = toafit.ToAFitConfig(kind=kind, ph_shift_res=200, n_brute=48, refine_iters=25)
+
+        phases, masks = toafit.pad_segments(segs)
+        plain = toafit.fit_toas_batch(
+            kind, tpl, jnp.asarray(phases), jnp.asarray(masks), jnp.asarray(exps), cfg
+        )
+        bucketed = toafit.fit_toas_bucketed(kind, tpl, segs, exps, cfg)
+        np.testing.assert_allclose(
+            bucketed["phShift"], np.asarray(plain["phShift"]), atol=1e-9
+        )
+        np.testing.assert_allclose(
+            bucketed["redChi2"], np.asarray(plain["redChi2"]), rtol=1e-9
+        )
+        # recovery sanity in original order
+        for i, s in enumerate(shifts):
+            err = max(bucketed["phShift_UL"][i], bucketed["phShift_LL"][i])
+            assert abs(bucketed["phShift"][i] - s) < 5 * err
+
+    def test_single_bucket_for_homogeneous_sizes(self):
+        rng = np.random.RandomState(33)
+        kind = profiles.FOURIER
+        tpl = template(kind)
+        segs = [draw_phases(kind, tpl, 900, rng) for _ in range(3)]
+        exps = np.full(3, 900 / 17.0)
+        cfg = toafit.ToAFitConfig(kind=kind, ph_shift_res=150, n_brute=32, refine_iters=20)
+        out = toafit.fit_toas_bucketed(kind, tpl, segs, exps, cfg)
+        assert out["phShift"].shape == (3,)
+        assert np.isfinite(out["phShift"]).all()
