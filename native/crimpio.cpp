@@ -296,15 +296,24 @@ long cio_filter_energy(const double* time, const double* pi, long n,
   return kept;
 }
 
-// Phase histogram: counts of phases in [0, upper) over nbins uniform bins.
+// Phase histogram: counts of phases over nbins uniform bins spanning
+// [0, upper]. Bin-edge semantics match numpy.histogram with explicit
+// linspace edges: bin k is [k*step, (k+1)*step) with the LAST bin closed
+// at upper. The scaled initial guess can land one bin off when phase*scale
+// rounds across an edge, so the guess is corrected against the same
+// edge expression numpy's linspace produces (k * (upper/nbins)).
 int cio_phase_histogram(const double* phases, long n, double upper, long nbins,
                         int64_t* counts) {
   memset(counts, 0, sizeof(int64_t) * nbins);
   const double scale = nbins / upper;
+  const double step = upper / nbins;
   for (long i = 0; i < n; ++i) {
-    long b = static_cast<long>(phases[i] * scale);
+    const double p = phases[i];
+    long b = static_cast<long>(p * scale);
     if (b < 0) b = 0;
     if (b >= nbins) b = nbins - 1;
+    while (b + 1 < nbins && p >= (b + 1) * step) ++b;
+    while (b > 0 && p < b * step) --b;
     ++counts[b];
   }
   return 0;

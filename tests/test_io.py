@@ -245,6 +245,28 @@ class TestNativeIO:
         ref, _ = np.histogram(ph, bins=32, range=(0.0, 1.0))
         np.testing.assert_array_equal(counts, ref)
 
+    @pytest.mark.parametrize("upper,nbins", [(1.0, 15), (1.0, 32), (2 * np.pi, 15), (2 * np.pi, 7)])
+    def test_phase_histogram_edge_semantics(self, upper, nbins):
+        """Values ON bin edges must bin exactly as numpy's explicit
+        linspace-edge histogram does (right-open interior bins, closed last
+        bin) — the scaled-index shortcut can land one bin off on edges."""
+        from crimp_tpu.io import native
+
+        if native.load() is None:
+            pytest.skip("native crimpio unavailable in this environment")
+        edges = np.linspace(0.0, upper, nbins + 1)
+        adversarial = np.concatenate([
+            edges,  # exact edges, including both endpoints
+            np.nextafter(edges, -np.inf)[1:],  # just below each edge
+            np.nextafter(edges, np.inf)[:-1],  # just above each edge
+            np.arange(nbins) * (upper / nbins),  # alternative edge arithmetic
+            np.random.RandomState(2).uniform(0, upper, 50000),
+        ])
+        adversarial = adversarial[(adversarial >= 0) & (adversarial <= upper)]
+        counts = native.phase_histogram(adversarial, upper, nbins)
+        ref, _ = np.histogram(adversarial, bins=edges)
+        np.testing.assert_array_equal(counts, ref)
+
 
 class TestAddPnTrack:
     def test_attaches_track_minus_two(self, tmp_path):
