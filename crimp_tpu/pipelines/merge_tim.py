@@ -24,36 +24,36 @@ TOA_ROUND_DECIMALS = 12  # fixed by design
 
 def _load_tim(timfile: str) -> pd.DataFrame:
     df = read_tim(timfile, skiprows=1)
-    if "pulse_ToA" not in df.columns:
-        raise ValueError(f"{timfile}: missing required column 'pulse_ToA'")
-    if "pn" not in df.columns:
+    absent = [col for col in ("pulse_ToA", "pn") if col not in df.columns]
+    if absent:
         raise ValueError(
-            f"{timfile}: missing required pulse number column 'pn'. "
-            "Make sure every TOA line has '-pn <int>'."
+            f"{timfile} lacks {absent}: a mergeable .tim needs ToA epochs and "
+            "a '-pn <int>' pulse-number flag on every line"
         )
     df["pn"] = pd.to_numeric(df["pn"], errors="raise").astype(np.int64)
-    return df.sort_values("pulse_ToA").reset_index(drop=True)
+    return df.sort_values("pulse_ToA", ignore_index=True)
 
 
 def expand_inputs(inputs: list[str]) -> list[str]:
     """.tim paths, or .txt list files with one .tim per line, in order."""
-    timfiles: list[str] = []
-    for item in inputs:
+
+    def entries(item: str) -> list[str]:
         path = Path(item)
-        if path.suffix.lower() == ".txt":
-            if not path.exists():
-                raise FileNotFoundError(f"List file not found: {item}")
-            for line in path.read_text().splitlines():
-                line = line.strip()
-                if line and not line.startswith("#"):
-                    timfiles.append(line)
-        else:
-            timfiles.append(item)
+        if path.suffix.lower() != ".txt":
+            return [item]
+        if not path.exists():
+            raise FileNotFoundError(f"list file does not exist: {item}")
+        lines = (raw.strip() for raw in path.read_text().splitlines())
+        return [line for line in lines if line and not line.startswith("#")]
+
+    timfiles = [t for item in inputs for t in entries(item)]
+    absent = [t for t in timfiles if not Path(t).exists()]
+    if absent:
+        raise FileNotFoundError("cannot merge, inputs not found: " + ", ".join(absent))
     if len(timfiles) < 2:
-        raise ValueError("Need at least two .tim files to merge.")
-    missing = [t for t in timfiles if not Path(t).exists()]
-    if missing:
-        raise FileNotFoundError("Missing .tim files:\n  " + "\n  ".join(missing))
+        raise ValueError(
+            f"merging requires at least two .tim files (got {len(timfiles)})"
+        )
     return timfiles
 
 
@@ -67,7 +67,10 @@ def _overlap_keys(a: pd.DataFrame, b: pd.DataFrame):
 def _merge_pair(merged: pd.DataFrame, nxt: pd.DataFrame) -> pd.DataFrame:
     key_prev, key_next, shared = _overlap_keys(merged, nxt)
     if shared.empty:
-        raise ValueError("No overlapping TOAs found between consecutive files.")
+        raise ValueError(
+            "consecutive .tim files share no ToAs (after rounding to "
+            f"{TOA_ROUND_DECIMALS} decimals); cannot anchor a pulse-number shift"
+        )
 
     anchor = float(np.min(shared.to_numpy(dtype=float)))
     shift = int(merged.loc[key_prev == anchor, "pn"].iloc[0]) - int(

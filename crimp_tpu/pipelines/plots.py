@@ -114,7 +114,9 @@ def plotting_phase_energy(df, nphasebins: int = 64, nenergybins: int = 24, smoot
 
 
 def plotting_phase_time(df, nphasebins: int = 32, ntimebins: int = 12, smooth_sigma=0.5, plotname=None):
-    """Phase-time map with gap-aware (NaN-weighted) smoothing."""
+    """Phase-time map: histogram2d, per-row min-max scaling, NaN-weighted
+    smoothing — the reference's own algorithm and defaults reproduced as-is
+    (plot_pps.py:196-271), not a re-design."""
     phases = df["foldedphases"].to_numpy()
     times = df["TIME"].to_numpy()
     phase_edges = np.linspace(0.0, 1.0, nphasebins + 1)
