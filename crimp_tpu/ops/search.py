@@ -37,6 +37,7 @@ all-f64 path for bit-level CPU parity checks.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -51,18 +52,46 @@ DEFAULT_TRIG_DTYPE = jnp.float32
 # the general defaults; see docs/performance.md).
 GRID_EVENT_BLOCK = 1 << 15
 GRID_TRIAL_BLOCK = 512
+# The fast path's f32 inner sweep carries phase error up to
+# trial_block/2 * 2^-24 cycles, which the Chebyshev recurrence amplifies
+# ~linearly in harmonic number; past this order the error budget is no
+# longer orders below the statistic's sqrt(N) noise, so auto mode falls
+# back to the exact-f64-phase general kernel.
+GRID_FASTPATH_MAX_NHARM = 8
+# Below this many (trial, event) pairs the dispatch/collective overhead of
+# auto-sharding outweighs the parallel win (PeriodSearch._mesh).
+MIN_SHARD_PAIRS = 1 << 22
 
 
-def _block_times(times: jax.Array, block: int):
+def grid_fastpath_enabled(nharm: int, override: bool | None = None) -> bool:
+    """Whether the uniform-grid f32 fast path should be used.
+
+    Resolution order: explicit ``override`` > env ``CRIMP_TPU_GRID_FASTPATH``
+    ("0"/"off" disables, "1"/"on" forces) > auto (nharm-based)."""
+    if override is not None:
+        return bool(override)
+    env = os.environ.get("CRIMP_TPU_GRID_FASTPATH", "auto").strip().lower()
+    if env in ("0", "off", "false", "never"):
+        return False
+    if env in ("1", "on", "true", "always"):
+        return True
+    return nharm <= GRID_FASTPATH_MAX_NHARM
+
+
+def _block_times(times: jax.Array, block: int, weights: jax.Array | None = None):
     """Pad times to a multiple of ``block`` and reshape to (n_blocks, block).
 
     Padded entries carry weight 0 so they contribute nothing to the sums.
+    ``weights`` lets a caller that already carries per-event validity (e.g.
+    an event shard whose tail is mesh padding) thread it through.
     """
     n = times.shape[0]
     n_blocks = -(-n // block)
     padded = jnp.pad(times, (0, n_blocks * block - n))
-    weights = jnp.pad(jnp.ones(n, dtype=times.dtype), (0, n_blocks * block - n))
-    return padded.reshape(n_blocks, block), weights.reshape(n_blocks, block)
+    if weights is None:
+        weights = jnp.ones(n, dtype=times.dtype)
+    w_padded = jnp.pad(weights.astype(times.dtype), (0, n_blocks * block - n))
+    return padded.reshape(n_blocks, block), w_padded.reshape(n_blocks, block)
 
 
 def _harmonic_sums_cycles(
@@ -98,7 +127,8 @@ def _harmonic_sums_cycles(
 
 
 def _blocked_trial_sums(
-    times, freqs, nharm, event_block, trial_block, trig_dtype, phase_fn
+    times, freqs, nharm, event_block, trial_block, trig_dtype, phase_fn,
+    weights=None,
 ):
     """Trig sums (nharm, n_freq), blocked on BOTH the trial and event axes.
 
@@ -108,7 +138,7 @@ def _blocked_trial_sums(
     multi-TB tensor). ``phase_fn(freq_blk, t_blk) -> cycles`` defines the
     trial family (pure frequency, frequency+fdot, ...).
     """
-    time_blocks, weight_blocks = _block_times(times, event_block)
+    time_blocks, weight_blocks = _block_times(times, event_block, weights)
     n_freq = freqs.shape[0]
     n_freq_blocks = -(-n_freq // trial_block)
     freq_padded = jnp.pad(freqs, (0, n_freq_blocks * trial_block - n_freq)).reshape(
@@ -122,7 +152,11 @@ def _blocked_trial_sums(
             c, s = _harmonic_sums_cycles(phase, w_blk[None, :], nharm, trig_dtype)
             return (carry[0] + c, carry[1] + s), None
 
-        zeros = jnp.zeros((nharm, trial_block), dtype=jnp.float64)
+        # Anchoring the init to the traced operands keeps the carry's
+        # shard_map "varying" axes identical to the body output when this
+        # runs inside a sharded kernel (compile-time no-op otherwise).
+        anchor = 0.0 * (time_blocks[0, 0] + freq_blk[0])
+        zeros = jnp.zeros((nharm, trial_block), dtype=jnp.float64) + anchor
         (c_sum, s_sum), _ = jax.lax.scan(step, (zeros, zeros), (time_blocks, weight_blocks))
         return c_sum, s_sum
 
@@ -213,6 +247,7 @@ def harmonic_sums_uniform(
     event_block: int = GRID_EVENT_BLOCK,
     trial_block: int = GRID_TRIAL_BLOCK,
     fdot: float | jax.Array = 0.0,
+    weights: jax.Array | None = None,
 ):
     """Trig sums over the uniform grid f0 + j*df — the f64-lean fast path.
 
@@ -227,7 +262,7 @@ def harmonic_sums_uniform(
     of the f64 work of the general path (f64 is software-emulated on TPU;
     measured +38% trials/s end-to-end on v5e).
     """
-    time_blocks, weight_blocks = _block_times(times, event_block)
+    time_blocks, weight_blocks = _block_times(times, event_block, weights)
     n_tiles = -(-n_freq // trial_block)
     j_lo = jnp.arange(trial_block, dtype=jnp.float32)
     # b = df*t reduced mod 1 ONCE in f64 (O(N)); j_lo*b only ever needs the
@@ -251,7 +286,10 @@ def harmonic_sums_uniform(
             c, s = _harmonic_sums_cycles(phase32, w_blk[None, :].astype(jnp.float32), nharm, jnp.float32)
             return (carry[0] + c, carry[1] + s), None
 
-        zeros = jnp.zeros((nharm, trial_block), dtype=jnp.float64)
+        # Anchor the init to the traced operands so the carry's shard_map
+        # "varying" axes match the body output inside sharded kernels.
+        anchor = 0.0 * (time_blocks[0, 0] + f_tile)
+        zeros = jnp.zeros((nharm, trial_block), dtype=jnp.float64) + anchor
         (c_sum, s_sum), _ = jax.lax.scan(
             step, (zeros, zeros), (time_blocks, weight_blocks, b_blocks)
         )
@@ -380,20 +418,49 @@ class PeriodSearch:
     """Reference-compatible search API (periodsearch.py:20-125).
 
     ``time`` in seconds; trials are centered on t0 = (time[0]+time[-1])/2.
-    The compute runs as jitted blockwise kernels on the default JAX device.
+    The compute runs as jitted blockwise kernels on the default JAX device;
+    on a multi-device host the event axis is automatically sharded across
+    all chips with psum combines (crimp_tpu.parallel.mesh.auto_mesh;
+    ``CRIMP_TPU_SHARD=0`` opts out) once the workload is large enough to
+    amortize the collectives.
     """
 
-    def __init__(self, time, freq, nbrHarm: int = 2):
+    def __init__(self, time, freq, nbrHarm: int = 2, use_grid_fastpath: bool | None = None):
         self.time = np.asarray(time, dtype=np.float64)
         self.freq = np.asarray(freq, dtype=np.float64)
         self.nbrHarm = int(nbrHarm)
         self.t0 = (self.time[0] + self.time[-1]) / 2
+        self.use_grid_fastpath = use_grid_fastpath
 
     def _centered(self) -> jax.Array:
         return jnp.asarray(self.time - self.t0)
 
+    def _grid(self):
+        """(f0, df) when the trial grid is uniform AND the fast path is on."""
+        if not grid_fastpath_enabled(self.nbrHarm, self.use_grid_fastpath):
+            return None
+        return uniform_grid(self.freq)
+
+    def _mesh(self, n_pairs: int | None = None):
+        """Device mesh for auto-sharding, or None for the single-device path."""
+        if n_pairs is None:
+            n_pairs = len(self.time) * len(self.freq)
+        if n_pairs < MIN_SHARD_PAIRS:
+            return None
+        from crimp_tpu.parallel import mesh as pmesh
+
+        return pmesh.auto_mesh()
+
     def ztest(self) -> np.ndarray:
-        grid = uniform_grid(self.freq)
+        mesh = self._mesh()
+        if mesh is not None:
+            from crimp_tpu.parallel import mesh as pmesh
+
+            return pmesh.z2_sharded(
+                self.time - self.t0, self.freq, self.nbrHarm, mesh,
+                use_fastpath=self.use_grid_fastpath,
+            )
+        grid = self._grid()
         if grid is not None:
             f0, df = grid
             return np.asarray(
@@ -402,7 +469,15 @@ class PeriodSearch:
         return np.asarray(z2_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm))
 
     def htest(self) -> np.ndarray:
-        grid = uniform_grid(self.freq)
+        mesh = self._mesh()
+        if mesh is not None:
+            from crimp_tpu.parallel import mesh as pmesh
+
+            return pmesh.h_sharded(
+                self.time - self.t0, self.freq, self.nbrHarm, mesh,
+                use_fastpath=self.use_grid_fastpath,
+            )
+        grid = self._grid()
         if grid is not None:
             f0, df = grid
             return np.asarray(
@@ -418,8 +493,15 @@ class PeriodSearch:
         """
         log_fdots = np.asarray(freq_dot, dtype=np.float64)
         signed = -(10.0**log_fdots)
-        grid = uniform_grid(self.freq)
-        if grid is not None:
+        mesh = self._mesh(len(self.time) * len(self.freq) * len(signed))
+        if mesh is not None:
+            from crimp_tpu.parallel import mesh as pmesh
+
+            power = pmesh.z2_2d_sharded(
+                self.time - self.t0, self.freq, signed, self.nbrHarm, mesh,
+                use_fastpath=self.use_grid_fastpath,
+            )
+        elif (grid := self._grid()) is not None:
             f0, df = grid
             power = np.asarray(
                 z2_power_2d_grid(

@@ -198,9 +198,12 @@ def _optimal_norm_amp(
         h_bb = -jnp.sum(inv_s**2, axis=1)
         det = h_aa * h_bb - h_ab**2
         # Damped fallback when the Hessian is near-singular (flat shape).
+        # The fallback is a 1-D Newton step on A alone: -h_aa >= 0, so the
+        # regularizer must be ADDED to keep the denominator positive — a
+        # subtracted epsilon flips the step to descent when h_aa ~ 0.
         safe = jnp.abs(det) > 1e-30
         det = jnp.where(safe, det, 1.0)
-        da = jnp.where(safe, -(h_bb * g_a - h_ab * g_b) / det, g_a / (-h_aa - 1e-30))
+        da = jnp.where(safe, -(h_bb * g_a - h_ab * g_b) / det, g_a / (-h_aa + 1e-30))
         db = jnp.where(safe, -(-h_ab * g_a + h_aa * g_b) / det, 0.0)
         b_new = jnp.clip(b + db, b_lo, b_hi)
         a_new = jnp.clip(a + da, feasible_a_lo(b_new), a_hi)
@@ -224,19 +227,20 @@ def _loglik_at(kind, tpl, s, a, b, mask, exposure, n_events):
     return jnp.where(positive, ll, -jnp.inf)
 
 
-def profile_loglik(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig):
+def profile_loglik(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig, warm_vec=None):
     """(LL(phi), A*(phi)) profile with the norm re-optimized per shift."""
-    ll, a, _ = profile_loglik_full(kind, tpl, x, mask, exposure, phis, cfg)
+    ll, a, _ = profile_loglik_full(kind, tpl, x, mask, exposure, phis, cfg, warm_vec)
     return ll, a
 
 
-def profile_loglik_full(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig):
+def profile_loglik_full(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig, warm_vec=None):
     """(LL(phi), A*(phi), b*(phi)): profile over phShift with the nuisance
     parameters re-optimized per shift — the vectorized analog of the
     reference's per-step refits. Dispatches to the general Nelder-Mead
-    path when cfg.free_idx names extra free template parameters."""
+    path when cfg.free_idx names extra free template parameters;
+    ``warm_vec`` (a flattened template vector) warm-starts that path."""
     if cfg.free_idx:
-        return _general_profile_loglik(kind, tpl, x, mask, exposure, phis, cfg)
+        return _general_profile_loglik(kind, tpl, x, mask, exposure, phis, cfg, warm_vec)
     n_events = jnp.sum(mask)
     s = shape_at_shifts(kind, tpl, x, phis)
     if cfg.vary_amps:
@@ -360,7 +364,7 @@ def free_param_spec(kind: str, template: dict, vary_amps: bool = False):
     return tuple(idx), tuple(lo), tuple(hi), n_free
 
 
-def _general_profile_vecs(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig):
+def _general_profile_vecs(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig, warm_vec=None):
     """Profile LL over phShift with ALL flagged template parameters refit per
     shift by a fixed-iteration bounded Nelder-Mead (vmapped over the grid);
     returns (LL, full refit parameter vector) per grid point.
@@ -368,11 +372,17 @@ def _general_profile_vecs(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig)
     This is the batched equivalent of the reference's readvaryparam mode,
     where every error-scan step re-runs lmfit over the free parameter set
     (measureToAs.py:331-376 with vary flags from defineinitialfitparam).
+    ``warm_vec`` warm-starts the simplex at a previous best-fit flattened
+    template vector — the error scan passes the optimum so each step refines
+    from the solution one grid step away instead of restarting cold at the
+    input template (the reference's sequential refits inherit lmfit state
+    the same way).
     """
     free_idx = jnp.asarray(cfg.free_idx, dtype=jnp.int32)
     tf = bounded_transform(jnp.asarray(cfg.free_lo), jnp.asarray(cfg.free_hi))
     base = _flatten_tpl(tpl)
-    u0 = tf.to_unbounded(base[free_idx])
+    start = base if warm_vec is None else warm_vec
+    u0 = tf.to_unbounded(start[free_idx])
 
     def one_phi(phi):
         def nll(u):
@@ -388,11 +398,11 @@ def _general_profile_vecs(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig)
     return ll, vecs
 
 
-def _general_profile_loglik(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig):
+def _general_profile_loglik(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig, warm_vec=None):
     """(LL, norm, ampShift) view of the general profile (API twin of the
     fixed-shape branch; fit_segment uses _general_profile_vecs directly when
     it also needs the refit shape vector)."""
-    ll, vecs = _general_profile_vecs(kind, tpl, x, mask, exposure, phis, cfg)
+    ll, vecs = _general_profile_vecs(kind, tpl, x, mask, exposure, phis, cfg, warm_vec)
     return ll, vecs[:, 0], vecs[:, 1 + 3 * tpl.n_comp]
 
 
@@ -424,20 +434,22 @@ def _binned_chi2(kind, tpl, x, mask, exposure, phi_best, a_best, b_best, cfg: To
     return chi2 / max(nbins - n_free, 1)
 
 
-def _error_scan(kind, tpl, x, mask, exposure, phi_best, ll_max, cfg: ToAFitConfig):
+def _error_scan(kind, tpl, x, mask, exposure, phi_best, ll_max, cfg: ToAFitConfig, warm_vec=None):
     """Likelihood-profile 1-sigma bounds by chunked vectorized stepping.
 
     Reproduces the reference counting: the reported bound is
     (k*+1)*step + step/2 where k* is the first step whose LL drop exceeds
     the half-chi2 threshold; if no crossing within res/2 steps the bound
-    saturates (measureToAs.py:331-376).
+    saturates (measureToAs.py:331-376). In readvaryparam mode ``warm_vec``
+    (the best-fit vector) seeds every per-step Nelder-Mead so the scan
+    refines from the optimum instead of restarting cold at the template.
     """
     step = (2 * jnp.pi) / cfg.ph_shift_res
     max_k = cfg.ph_shift_res // 2
     chunk = cfg.err_chunk
 
     def scan_profile(phis):
-        ll, _ = profile_loglik(kind, tpl, x, mask, exposure, phis, cfg)
+        ll, _ = profile_loglik(kind, tpl, x, mask, exposure, phis, cfg, warm_vec)
         return ll
 
     def one_side(sign):
@@ -506,8 +518,12 @@ def fit_segment(kind: str, tpl: ProfileParams, x: jax.Array, mask: jax.Array, ex
             _flatten_tpl(tpl).at[0].set(a_best).at[1 + 3 * tpl.n_comp].set(b_best)
         )
 
-    # 4) likelihood-profile error bounds
-    err_lo, err_hi = _error_scan(kind, tpl, x, mask, exposure, phi_best, ll_max, cfg)
+    # 4) likelihood-profile error bounds (in readvaryparam mode each step's
+    #    Nelder-Mead starts from the best-fit vector, not the cold template)
+    warm = vec_best if cfg.free_idx else None
+    err_lo, err_hi = _error_scan(
+        kind, tpl, x, mask, exposure, phi_best, ll_max, cfg, warm
+    )
 
     # 5) binned-profile goodness of fit (general mode evaluates the model at
     #    the REFIT shape parameters, with ampShift folded into the template)
@@ -548,6 +564,56 @@ def fit_toas_batch(
     return jax.vmap(lambda x, m, t: fit_segment(kind, tpl, x, m, t, cfg))(
         phases, masks, exposures
     )
+
+
+def fit_toas_batch_auto(
+    kind: str,
+    tpl: ProfileParams,
+    phases,
+    masks,
+    exposures,
+    cfg: ToAFitConfig,
+) -> dict:
+    """``fit_toas_batch`` with the SEGMENT axis auto-sharded across devices.
+
+    On a multi-chip host (auto_mesh; ``CRIMP_TPU_SHARD=0`` opts out) the
+    batch is padded to a device multiple — padding rows are fully masked
+    segments, dropped from the result — and placed with its leading axis
+    sharded so the vmapped per-segment fits run data-parallel with zero
+    communication (the distributed analog of the reference's serial per-ToA
+    loop, measureToAs.py:168). Falls back to the plain single-device batch
+    whenever sharding wouldn't help (few segments, one device)."""
+    import jax
+
+    from crimp_tpu.parallel import mesh as pmesh
+
+    phases = np.asarray(phases)
+    masks = np.asarray(masks)
+    exposures = np.asarray(exposures, dtype=float)
+    n_seg = phases.shape[0]
+    n_devices = len(jax.devices()) if pmesh.sharding_enabled() else 1
+    if n_devices < 2 or n_seg < n_devices:
+        return fit_toas_batch(
+            kind, tpl, jnp.asarray(phases), jnp.asarray(masks),
+            jnp.asarray(exposures), cfg,
+        )
+    smesh = pmesh.segment_mesh()
+    pad = pmesh.pad_batch_for_mesh(n_seg, smesh)
+    if pad:
+        phases = np.concatenate([phases, np.zeros((pad,) + phases.shape[1:])])
+        masks = np.concatenate(
+            [masks, np.zeros((pad,) + masks.shape[1:], dtype=masks.dtype)]
+        )
+        exposures = np.concatenate([exposures, np.ones(pad)])
+    out = fit_toas_batch(
+        kind,
+        tpl,
+        pmesh.shard_segments(phases, smesh),
+        pmesh.shard_segments(masks, smesh),
+        pmesh.shard_segments(exposures, smesh),
+        cfg,
+    )
+    return {k: v[:n_seg] for k, v in out.items()}
 
 
 def pad_segments(phase_list: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray]:
@@ -604,10 +670,7 @@ def fit_toas_bucketed(
     out: dict[str, np.ndarray] = {}
     for bucket in buckets:
         phases, masks = pad_segments([phase_list[i] for i in bucket])
-        res = fit_toas_batch(
-            kind, tpl, jnp.asarray(phases), jnp.asarray(masks),
-            jnp.asarray(exposures[bucket]), cfg,
-        )
+        res = fit_toas_batch_auto(kind, tpl, phases, masks, exposures[bucket], cfg)
         for key, val in res.items():
             arr = np.asarray(val)
             if key not in out:

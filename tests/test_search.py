@@ -195,6 +195,97 @@ class TestUniformGridFastPath:
         assert abs(ps.freq[int(np.argmax(power))] - 0.25) < 5e-5
 
 
+class TestHPowerSegments:
+    def test_pins_reference_per_toa_htest(self):
+        """The batched per-segment H backing the ToA table must equal the
+        reference's per-ToA `PeriodSearch(t*86400, f, 5).htest()`
+        (measureToAs.py:211-212): times centered at (t0+tN)/2 by the caller,
+        H = max_m(cumsum Z^2_m - 4(m-1)) at the single local frequency."""
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(17)
+        nharm = 5
+        sizes = [1200, 800]
+        freqs = np.array([0.1432, 0.2791])
+        n_max = max(sizes)
+        sec = np.zeros((2, n_max))
+        msk = np.zeros((2, n_max))
+        expected = np.zeros(2)
+        for i, (n, f) in enumerate(zip(sizes, freqs)):
+            t = np.sort(rng.uniform(0, 5.0e4, n))
+            centered = t - (t[0] + t[-1]) / 2  # reference PeriodSearch t0
+            sec[i, :n] = centered
+            msk[i, :n] = 1.0
+            z2_terms = naive_z2_terms(centered, f, nharm)
+            expected[i] = np.max(
+                np.cumsum(z2_terms) - 4.0 * np.arange(nharm)
+            )
+        got64 = np.asarray(
+            search.h_power_segments(
+                jnp.asarray(sec), jnp.asarray(msk), jnp.asarray(freqs),
+                nharm=nharm, trig_dtype=jnp.float64,
+            )
+        )
+        np.testing.assert_allclose(got64, expected, rtol=1e-10, atol=1e-8)
+        got32 = np.asarray(
+            search.h_power_segments(
+                jnp.asarray(sec), jnp.asarray(msk), jnp.asarray(freqs), nharm=nharm
+            )
+        )
+        np.testing.assert_allclose(got32, expected, rtol=1e-3, atol=0.05)
+
+
+def naive_z2_terms(times, f, nharm):
+    """Per-harmonic Z^2 terms of the reference formula (periodsearch.py:57-71,
+    109-125) at one frequency."""
+    n = len(times)
+    terms = np.zeros(nharm)
+    for k in range(1, nharm + 1):
+        theta = 2 * np.pi * k * f * times
+        terms[k - 1] = (np.cos(theta).sum() ** 2 + np.sin(theta).sum() ** 2) * 2.0 / n
+    return terms
+
+
+class TestGridFastpathOptOut:
+    def test_auto_threshold(self):
+        assert search.grid_fastpath_enabled(2)
+        assert search.grid_fastpath_enabled(search.GRID_FASTPATH_MAX_NHARM)
+        assert not search.grid_fastpath_enabled(search.GRID_FASTPATH_MAX_NHARM + 1)
+        assert not search.grid_fastpath_enabled(20)
+
+    def test_explicit_override_beats_auto_and_env(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_GRID_FASTPATH", "off")
+        assert search.grid_fastpath_enabled(2, override=True)
+        monkeypatch.setenv("CRIMP_TPU_GRID_FASTPATH", "on")
+        assert not search.grid_fastpath_enabled(2, override=False)
+
+    def test_env_var_overrides_auto(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_GRID_FASTPATH", "0")
+        assert not search.grid_fastpath_enabled(2)
+        monkeypatch.setenv("CRIMP_TPU_GRID_FASTPATH", "1")
+        assert search.grid_fastpath_enabled(20)
+
+    def test_high_nharm_htest_takes_exact_path(self, sim_events, monkeypatch):
+        """Default H-test order (20) must run the exact-f64-phase kernel on a
+        uniform grid: the f32 fast-path phase error grows ~linearly with
+        harmonic number (Chebyshev recurrence amplification). Single-device
+        pinned: auto-sharding would change the accumulation order."""
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("CRIMP_TPU_SHARD", "0")
+        freqs = np.linspace(0.2495, 0.2505, 128)
+        ps = search.PeriodSearch(sim_events, freqs, 20)
+        assert ps._grid() is None  # auto mode declines the fast path
+        auto = ps.htest()
+        sec = sim_events - ps.t0
+        general = np.asarray(search.h_power(jnp.asarray(sec), jnp.asarray(freqs), 20))
+        np.testing.assert_array_equal(auto, general)
+        # forcing the fast path still gives statistically equivalent power
+        forced = search.PeriodSearch(sim_events, freqs, 20, use_grid_fastpath=True)
+        assert forced._grid() is not None
+        np.testing.assert_allclose(forced.htest(), general, rtol=5e-3, atol=0.5)
+
+
 class Test2DGridFastPath:
     def test_matches_general_2d(self, sim_events):
         import jax.numpy as jnp

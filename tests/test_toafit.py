@@ -209,6 +209,65 @@ class TestVaryAmps:
         assert abs(res["ampShift"] - injected_b) < 0.15
 
 
+class TestDegenerateSegments:
+    def test_empty_segment_falls_to_norm_lower_bound(self):
+        """A fully-masked segment hits the near-singular Hessian fallback of
+        the joint (norm, ampShift) solve: with no events the extended LL is
+        -A*T, maximized at the norm LOWER bound. A wrong-signed regularizer
+        in the fallback denominator drives A to the upper bound instead."""
+        kind = profiles.FOURIER
+        tpl = template(kind)
+        rng = np.random.RandomState(41)
+        good = draw_phases(kind, tpl, 2000, rng)
+        phases = np.zeros((2, 2000))
+        phases[0] = good
+        masks = np.zeros((2, 2000), dtype=bool)
+        masks[0] = True  # segment 1 has zero valid events
+        cfg = toafit.ToAFitConfig(
+            kind=kind, ph_shift_res=150, n_brute=32, refine_iters=20, vary_amps=True
+        )
+        out = toafit.fit_toas_batch(
+            kind, tpl, jnp.asarray(phases), jnp.asarray(masks),
+            jnp.asarray([2000 / 17.0, 2000 / 17.0]), cfg,
+        )
+        norms = np.asarray(out["norm"])
+        lo = cfg.norm_lo_frac * float(tpl.norm)
+        assert norms[1] < 10 * lo  # collapsed toward the lower bound
+        assert abs(norms[0] - 17.0) < 3.0  # healthy segment unaffected
+
+
+class TestWarmStartErrorScan:
+    def test_warm_start_dominates_cold_start(self):
+        """In readvaryparam mode each error-scan step refits the free shape
+        parameters; seeding the simplex at the best-fit vector must never
+        lose to the cold template start, and should win when the iteration
+        budget is tight (the reference's sequential lmfit refits inherit
+        state the same way)."""
+        from crimp_tpu.ops.toafit import _general_profile_vecs, fit_segment
+
+        kind = profiles.FOURIER
+        tpl = template(kind)
+        rng = np.random.RandomState(43)
+        phases = jnp.asarray(draw_phases(kind, tpl, 3000, rng, ph_shift=0.3))
+        mask = jnp.ones_like(phases, dtype=bool)
+        exposure = jnp.asarray(3000 / 17.0)
+        free_idx, lo, hi = (0, 1, 2), (5.0, 0.1, 1.0), (50.0, 5.0, 8.0)
+        cfg = toafit.ToAFitConfig(
+            kind=kind, ph_shift_res=150, n_brute=32, refine_iters=20,
+            free_idx=free_idx, free_lo=lo, free_hi=hi, nm_iters=25,
+        )
+        best = fit_segment(kind, tpl, phases, mask, exposure, cfg)
+        phis = jnp.asarray(float(best["phShift"]) + np.linspace(-0.3, 0.3, 9))
+        ll_cold, _ = _general_profile_vecs(kind, tpl, phases, mask, exposure, phis, cfg)
+        ll_warm, _ = _general_profile_vecs(
+            kind, tpl, phases, mask, exposure, phis, cfg, warm_vec=best["theta_best"]
+        )
+        ll_cold = np.asarray(ll_cold)
+        ll_warm = np.asarray(ll_warm)
+        assert (ll_warm >= ll_cold - 1e-6).all()
+        assert ll_warm.sum() >= ll_cold.sum()
+
+
 class TestBucketedFit:
     def test_matches_plain_batch_and_orders_results(self):
         """Size-bucketed fits must reproduce the pad-to-max results in the
