@@ -4,7 +4,9 @@ Workload (BASELINE.md): the 84-ToA extraction of the 1E 2259+586 campaign —
 brute global grid + refine + likelihood-profile errors at phShiftRes=1000 —
 which takes the reference ~202 s (~0.4158 ToA/s) on CPU
 (/root/reference/data/ToAs_2259.log), plus a 1e5-trial Z^2 scan
-(BASELINE.json config 2).
+(BASELINE.json config 2), the NORTH STAR as one wall clock (full 2-D
+(nu, nudot) Z^2 scan + the 84-ToA extraction, target <10 s), and the
+config-4 shape (500-segment batched unbinned-ML ToA fit).
 
 The merged ~1-yr event file is absent from the reference snapshot
 (.MISSING_LARGE_BLOBS), so the dataset is a synthetic surrogate shaped to
@@ -14,12 +16,19 @@ committed [start, end] windows so the full pipeline (anchored fold ->
 batched fit -> error scans -> H-test) runs end to end.
 
 Prints ONE JSON line: ToAs/sec with vs_baseline against the reference's
-0.4158 ToA/s. Z^2 trial throughput goes to stderr as context.
+0.4158 ToA/s, plus north-star/config-4/platform fields. Z^2 trial
+throughput goes to stderr as context.
+
+A wedged accelerator relay must never zero the official record (it did in
+round 1): the default backend is probed in a SUBPROCESS with a timeout and
+one retry, and on failure the whole bench runs on CPU with a
+"platform": "cpu" tag.
 """
 
 from __future__ import annotations
 
 import json
+import subprocess
 import sys
 import time
 
@@ -31,6 +40,39 @@ REFERENCE_TOAS_PER_SEC = 84 / 202.0  # data/ToAs_2259.log timestamps
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def choose_platform(timeout_s: float = 240.0) -> str:
+    """Probe the default JAX backend out-of-process; 'cpu' on failure.
+
+    The probe runs in a subprocess because a wedged relay HANGS inside
+    backend init rather than raising — an in-process attempt would take the
+    bench down with it. One retry, then CPU fallback.
+    ``CRIMP_TPU_BENCH_PLATFORM`` or ``JAX_PLATFORMS=cpu`` skip the probe.
+    """
+    import os
+
+    forced = os.environ.get("CRIMP_TPU_BENCH_PLATFORM", "").strip()
+    if forced:
+        return forced
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        return "cpu"
+    probe = "import jax; print(jax.devices()[0].platform)"
+    for attempt in (1, 2):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe],
+                timeout=timeout_s, capture_output=True, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                return out.stdout.strip().splitlines()[-1]
+            log(f"[bench] backend probe attempt {attempt} failed "
+                f"(rc={out.returncode}): {out.stderr.strip()[-300:]}")
+        except subprocess.TimeoutExpired:
+            log(f"[bench] backend probe attempt {attempt} timed out after {timeout_s}s")
+        if attempt == 1:
+            time.sleep(3)
+    return "cpu"
 
 
 def build_surrogate(par_path: str, intervals_path: str, template_path: str, events_per_toa: int = 10000, seed: int = 7):
@@ -193,20 +235,167 @@ def bench_z2(times: np.ndarray, n_trials: int = 100_000) -> dict:
     }
 
 
+def bench_north_star(par_path: str, template_path: str, times: np.ndarray, intervals,
+                     n_freq: int = 2500, n_fdot: int = 40) -> dict:
+    """The BASELINE north star as ONE wall clock: full 2-D (nu, nudot) Z^2
+    scan (1e5 trials: 2500 nu x 40 nudot) + the 84-ToA extraction on the
+    bundled-campaign surrogate. Target <10 s."""
+    import jax.numpy as jnp
+
+    from crimp_tpu.io import template as template_io
+    from crimp_tpu.models import profiles, timing
+    from crimp_tpu.ops import anchored, search, toafit
+    from crimp_tpu.ops.ephem import spin_frequency_host
+
+    tm = timing.resolve(par_path)
+    tpl_dict = template_io.read_template(template_path)
+    kind, tpl = profiles.from_template(tpl_dict)
+
+    sec = (times - times.mean()) * 86400.0
+    freqs = np.linspace(0.1430, 0.1436, n_freq)
+    log_fdots = np.linspace(-14.5, -13.5, n_fdot)  # log10 |nudot|, spin-down
+
+    starts = intervals["ToA_tstart"].to_numpy()
+    ends = intervals["ToA_tend"].to_numpy()
+    exposures = intervals["ToA_exposure"].to_numpy().astype(float)
+
+    def run_once():
+        # --- 2-D periodicity scan (PeriodSearch CLI semantics) ------------
+        ps = search.PeriodSearch(sec, freqs, 2)
+        rows, _ = ps.twod_ztest(log_fdots)
+        # --- ToA extraction over the committed 84 intervals ----------------
+        toa_mids = np.zeros(len(intervals))
+        seg_times = []
+        for i in range(len(intervals)):
+            t_seg = times[(times >= starts[i]) & (times <= ends[i])]
+            toa_mids[i] = (t_seg[-1] - t_seg[0]) / 2 + t_seg[0]
+            seg_times.append(t_seg)
+        am = anchored.prepare_anchors(tm, toa_mids)
+        sizes = [t.size for t in seg_times]
+        anchor_idx = np.repeat(np.arange(len(seg_times)), sizes)
+        deltas = anchored.anchor_deltas(np.concatenate(seg_times), toa_mids, anchor_idx)
+        folded = np.asarray(
+            anchored.anchored_fold(am, jnp.asarray(deltas), jnp.asarray(anchor_idx))
+        )
+        seg_phases = list(np.split(folded, np.cumsum(sizes)[:-1]))
+        phases, masks = toafit.pad_segments(seg_phases)
+        cfg = toafit.ToAFitConfig(kind=kind, ph_shift_res=1000, nbins=15)
+        fit = toafit.fit_toas_batch(kind, tpl, phases, masks, exposures, cfg)
+        fit = {k: np.asarray(v) for k, v in fit.items()}
+        freqs_mid, _ = spin_frequency_host(tm, toa_mids)
+        sec_seg = np.zeros_like(phases)
+        msk = np.zeros_like(masks)
+        for i, t_seg in enumerate(seg_times):
+            sec_seg[i, : t_seg.size] = (t_seg - (t_seg[0] + t_seg[-1]) / 2) * 86400.0
+            msk[i, : t_seg.size] = True
+        fit["Hpower"] = np.asarray(search.h_power_segments(sec_seg, msk, freqs_mid, nharm=5))
+        return rows, fit
+
+    run_once()  # compile both device programs
+    t0 = time.perf_counter()
+    rows, fit = run_once()
+    wall = time.perf_counter() - t0
+    peak_i = int(np.argmax(rows[:, 2]))
+    return {
+        "wall_s": wall,
+        "n_trials_2d": n_freq * n_fdot,
+        "n_toas": len(intervals),
+        "peak_freq": float(rows[peak_i, 0]),
+        "peak_z2": float(rows[peak_i, 2]),
+        "median_H": float(np.median(fit["Hpower"])),
+    }
+
+
+def bench_config4(template_path: str, n_segments: int = 500, events_per_seg: int = 2000,
+                  seed: int = 11) -> dict:
+    """BASELINE config 4: 500-segment batched unbinned-ML template fit at
+    full phShiftRes=1000 (the multi-epoch vmap-over-segments shape)."""
+    import jax.numpy as jnp
+
+    from crimp_tpu.io import template as template_io
+    from crimp_tpu.models import profiles
+    from crimp_tpu.ops import toafit
+
+    tpl_dict = template_io.read_template(template_path)
+    kind, tpl = profiles.from_template(tpl_dict)
+
+    amp = np.asarray(tpl.amp)
+    loc = np.asarray(tpl.loc)
+    norm = float(tpl.norm)
+    rng = np.random.RandomState(seed)
+    grid = np.linspace(0, 1, 4097)
+    j = np.arange(1, len(amp) + 1)[:, None]
+    pdf = np.clip(
+        norm + np.sum(amp[:, None] * np.cos(j * 2 * np.pi * grid[None, :] + loc[:, None]), axis=0),
+        0.0, None,
+    )
+    cdf = np.concatenate([[0.0], np.cumsum((pdf[1:] + pdf[:-1]) / 2)])
+    cdf /= cdf[-1]
+    shifts = rng.uniform(-0.3, 0.3, n_segments)
+    phases = np.empty((n_segments, events_per_seg))
+    for s in range(n_segments):
+        draws = np.interp(rng.uniform(0, 1, events_per_seg), cdf, grid)
+        phases[s] = np.mod(draws + shifts[s] / (2 * np.pi), 1.0)
+    masks = np.ones_like(phases, dtype=bool)
+    exposures = np.full(n_segments, events_per_seg / norm)
+
+    cfg = toafit.ToAFitConfig(kind=kind, ph_shift_res=1000, nbins=15)
+
+    def run_once():
+        fit = toafit.fit_toas_batch_auto(kind, tpl, phases, masks, exposures, cfg)
+        return {k: np.asarray(v) for k, v in fit.items()}
+
+    run_once()  # compile
+    t0 = time.perf_counter()
+    fit = run_once()
+    wall = time.perf_counter() - t0
+    # ph_shift enters the Fourier curve as -j*phShift: recovered phase-cycle
+    # offset = phShift/(2*pi); compare against the injected shifts
+    resid = (fit["phShift"] - shifts + np.pi) % (2 * np.pi) - np.pi
+    return {
+        "wall_s": wall,
+        "toas_per_sec": n_segments / wall,
+        "n_segments": n_segments,
+        "median_abs_resid_rad": float(np.median(np.abs(resid))),
+        "recovered_frac": float(np.mean(np.abs(resid) < 5 * np.maximum(
+            fit["phShift_UL"], fit["phShift_LL"]))),
+    }
+
+
 def main():
     import pathlib
+
+    platform = choose_platform()
+    import jax
+
+    if platform == "cpu":
+        # a wedged relay must not zero the record: label and run on host
+        jax.config.update("jax_platforms", "cpu")
+        log("[bench] accelerator unavailable -> running on CPU (tagged)")
+    log(f"[bench] platform: {platform}")
 
     here = pathlib.Path(__file__).parent
     par = str(here / "tests/data/1e2259.par")
     intervals_path = str(here / "tests/data/timIntToAs_1e2259.txt")
     template = str(here / "tests/data/1e2259_template.txt")
 
+    # The CPU fallback must FINISH inside a round-end budget, not just run
+    # (single-core hosts exist — this one): events AND trial grids shrink.
+    # Rates stay labeled; absolute wall-clock fields are only claimed
+    # against the target on an accelerator.
+    on_cpu = platform == "cpu"
+    events_per_toa = 2_000 if on_cpu else 10_000
+    z2_trials = 2_000 if on_cpu else 100_000
+    ns_freq, ns_fdot = (250, 8) if on_cpu else (2500, 40)
+    cfg4_segments, cfg4_events = (100, 1_000) if on_cpu else (500, 2_000)
+
     log("[bench] building synthetic merged-campaign surrogate ...")
-    times, intervals = build_surrogate(par, intervals_path, template)
+    times, intervals = build_surrogate(par, intervals_path, template,
+                                       events_per_toa=events_per_toa)
     log(f"[bench] surrogate: {len(times)} events over {len(intervals)} intervals")
 
-    z2 = bench_z2(times)
-    log(f"[bench] Z^2 1e5 trials x {z2['n_events']} events: {z2['wall_s']:.2f}s "
+    z2 = bench_z2(times, n_trials=z2_trials)
+    log(f"[bench] Z^2 {z2_trials} trials x {z2['n_events']} events: {z2['wall_s']:.2f}s "
         f"({z2['trials_per_sec']:.0f} trials/s), peak {z2['peak']:.0f} at {z2['peak_freq']:.6f} Hz")
 
     toas = bench_toas(par, intervals_path, template, times, intervals)
@@ -215,11 +404,31 @@ def main():
         f"median H {toas['median_H']:.0f})")
     log(f"[bench] reference: {REFERENCE_TOAS_PER_SEC:.4f} ToA/s (202 s for 84 ToAs, data/ToAs_2259.log)")
 
+    north = bench_north_star(par, template, times, intervals, n_freq=ns_freq, n_fdot=ns_fdot)
+    log(f"[bench] NORTH STAR one-run: 2-D Z^2 {north['n_trials_2d']} trials + "
+        f"{north['n_toas']} ToAs in {north['wall_s']:.2f}s (target <10s); "
+        f"peak Z^2 {north['peak_z2']:.0f} at {north['peak_freq']:.6f} Hz")
+
+    cfg4 = bench_config4(template, n_segments=cfg4_segments, events_per_seg=cfg4_events)
+    log(f"[bench] config-4: {cfg4['n_segments']} segments in {cfg4['wall_s']:.2f}s = "
+        f"{cfg4['toas_per_sec']:.1f} ToA/s; {100*cfg4['recovered_frac']:.1f}% of injected "
+        f"shifts recovered within 5 sigma")
+
     print(json.dumps({
         "metric": "toa_extraction_throughput_84toa_res1000",
         "value": round(toas["toas_per_sec"], 3),
         "unit": "ToA/s",
         "vs_baseline": round(toas["toas_per_sec"] / REFERENCE_TOAS_PER_SEC, 2),
+        "platform": platform,
+        "cpu_scaled_workloads": on_cpu,
+        "north_star_trials": north["n_trials_2d"],
+        "north_star_wall_s": round(north["wall_s"], 3),
+        "north_star_under_10s": (north["wall_s"] < 10.0) and not on_cpu,
+        "z2_trials_per_sec": round(z2["trials_per_sec"], 1),
+        "config4_n_segments": cfg4["n_segments"],
+        "config4_wall_s": round(cfg4["wall_s"], 3),
+        "config4_toas_per_sec": round(cfg4["toas_per_sec"], 1),
+        "config4_recovered_frac": cfg4["recovered_frac"],
     }))
 
 
