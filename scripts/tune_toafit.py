@@ -1,0 +1,127 @@
+"""ToAFitConfig tuning sweep (VERDICT r2 item 8).
+
+Sweeps the admitted-guess knobs — newton_iters, refine_iters, err_chunk,
+n_brute — on the bench workload (84 segments x 1e4 events, ph_shift_res=
+1000) and reports wall-clock vs accuracy against a high-effort reference
+configuration, so defaults can be picked on the frontier instead of by
+guess.
+
+Accuracy columns:
+- d_phi: max |phShift - phShift_ref| in radians (continuous optimum drift)
+- d_err: max |bound - bound_ref| in UNITS OF THE SCAN STEP (bounds are
+  quantized to k*step + step/2, so any nonzero value is a real step flip)
+
+Usage: python scripts/tune_toafit.py [--events 10000] [--res 1000]
+Run on the accelerator for defaults that matter (CPU ratios differ).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=10_000)
+    ap.add_argument("--segments", type=int, default=84)
+    ap.add_argument("--res", type=int, default=1000)
+    ap.add_argument("--repeat", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    from crimp_tpu.io import template as template_io
+    from crimp_tpu.models import profiles
+    from crimp_tpu.ops import toafit
+
+    here = pathlib.Path(__file__).resolve().parents[1]
+    tpl_dict = template_io.read_template(str(here / "tests/data/1e2259_template.txt"))
+    kind, tpl = profiles.from_template(tpl_dict)
+
+    rng = np.random.RandomState(13)
+    amp, loc, norm = np.asarray(tpl.amp), np.asarray(tpl.loc), float(tpl.norm)
+    grid = np.linspace(0, 1, 4097)
+    j = np.arange(1, len(amp) + 1)[:, None]
+    pdf = np.clip(norm + np.sum(amp[:, None] * np.cos(j * 2 * np.pi * grid[None, :] + loc[:, None]), axis=0), 0, None)
+    cdf = np.concatenate([[0.0], np.cumsum((pdf[1:] + pdf[:-1]) / 2)])
+    cdf /= cdf[-1]
+    shifts = rng.uniform(-0.5, 0.5, args.segments)
+    phases = np.empty((args.segments, args.events))
+    for s in range(args.segments):
+        draws = np.interp(rng.uniform(0, 1, args.events), cdf, grid)
+        phases[s] = np.mod(draws + shifts[s] / (2 * np.pi), 1.0)
+    masks = np.ones_like(phases, dtype=bool)
+    exposures = np.full(args.segments, args.events / norm)
+    xp, xm, xe = jnp.asarray(phases), jnp.asarray(masks), jnp.asarray(exposures)
+
+    def run(cfg):
+        fit = toafit.fit_toas_batch(kind, tpl, xp, xm, xe, cfg)
+        return {k: np.asarray(v) for k, v in fit.items()}
+
+    def timed(cfg):
+        run(cfg)  # compile
+        best = np.inf
+        for _ in range(args.repeat):
+            t0 = time.perf_counter()
+            out = run(cfg)
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    # High-effort reference: everything cranked up.
+    ref_cfg = toafit.ToAFitConfig(
+        kind=kind, ph_shift_res=args.res, n_brute=512,
+        newton_iters=60, refine_iters=80, err_chunk=32,
+    )
+    log("[tune] running high-effort reference config ...")
+    ref_wall, ref = timed(ref_cfg)
+    step = 2 * np.pi / args.res
+    log(f"[tune] reference wall {ref_wall:.2f}s")
+
+    sweep = {
+        "newton_iters": [10, 20, 30, 45],
+        "refine_iters": [15, 25, 50],
+        "err_chunk": [16, 32, 64, 128],
+        "n_brute": [48, 96, 128, 256],
+    }
+    defaults = dict(newton_iters=30, refine_iters=50, err_chunk=32, n_brute=128)
+
+    results = []
+    # axis-by-axis sweep around the current defaults (full product would be
+    # 192 compiles); each axis varies alone
+    for axis, values in sweep.items():
+        for v in values:
+            kw = dict(defaults)
+            kw[axis] = v
+            cfg = toafit.ToAFitConfig(kind=kind, ph_shift_res=args.res, **kw)
+            wall, out = timed(cfg)
+            d_phi = float(np.max(np.abs(out["phShift"] - ref["phShift"])))
+            d_err = float(
+                max(
+                    np.max(np.abs(out["phShift_LL"] - ref["phShift_LL"])),
+                    np.max(np.abs(out["phShift_UL"] - ref["phShift_UL"])),
+                ) / step
+            )
+            row = {"axis": axis, "value": v, "wall_s": round(wall, 3),
+                   "toas_per_sec": round(args.segments / wall, 1),
+                   "d_phi_rad": round(d_phi, 6), "d_err_steps": round(d_err, 2)}
+            results.append(row)
+            log(f"[tune] {axis}={v}: {row['wall_s']}s, d_phi={row['d_phi_rad']}, "
+                f"d_err={row['d_err_steps']} steps")
+
+    print(json.dumps({"reference_wall_s": round(ref_wall, 3), "rows": results}))
+
+
+if __name__ == "__main__":
+    main()
