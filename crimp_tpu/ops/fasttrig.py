@@ -1,0 +1,69 @@
+"""Polynomial sin/cos on mod-1-reduced phases — the cheap-transcendental path.
+
+The search kernels reduce the trial phase mod 1 in f64 before any trig, so
+the argument is ALWAYS in [-0.5, 0.5] cycles; a full libm sine pays for
+range reduction and ~1e-7 relative accuracy the Z^2/H statistics cannot
+use (their own f32 phase carries ~1e-5-cycle error, and the statistic's
+noise floor is sqrt(N)). These fixed odd/even least-squares polynomials
+evaluate sin(2*pi*x) and cos(2*pi*x) directly on the reduced argument in
+~13 VPU FMAs per pair:
+
+    max |error| = 3.1e-7 (sin), 3.6e-8 (cos)  over |x| <= 0.5
+
+— a few times the hardware path's own f32 output rounding (~6e-8), but
+two orders below the ~1e-5-cycle phase error both paths already carry and
+far below the statistic's sqrt(N) noise floor. Opt-in via ``CRIMP_TPU_POLY_TRIG=1`` (or the ``poly_trig`` argument of
+``PeriodSearch``); the on-chip win depends on the hardware's native
+transcendental cost (docs/performance.md "Z^2 roofline" — the C_trig
+microbenchmark in tests/test_tpu_tier.py decides).
+"""
+
+from __future__ import annotations
+
+import os
+
+# Least-squares fits on [-0.5, 0.5] (degree 11 odd / 12 even in x; fit and
+# error bounds reproduced by tests/test_search.py::TestPolyTrig).
+_SIN_COEFFS = (
+    6.2831834664e00,
+    -4.1341480362e01,
+    8.1597658022e01,
+    -7.6594929804e01,
+    4.1269936976e01,
+    -1.2372507211e01,
+)
+_COS_COEFFS = (
+    9.9999999229e-01,
+    -1.9739205554e01,
+    6.4939172239e01,
+    -8.5451165912e01,
+    6.0176231390e01,
+    -2.6000532120e01,
+    6.5756180224e00,
+)
+
+
+def poly_trig_enabled(override: bool | None = None) -> bool:
+    """Whether search kernels should use the polynomial sin/cos pair."""
+    if override is not None:
+        return bool(override)
+    return os.environ.get("CRIMP_TPU_POLY_TRIG", "").strip().lower() in (
+        "1", "on", "true", "always",
+    )
+
+
+def sincos_cycles(frac):
+    """(sin, cos) of 2*pi*frac for frac in [-0.5, 0.5] (any float dtype).
+
+    Horner evaluation in z = frac^2: 1 mul + 5 FMA + 1 mul for sin,
+    6 FMA for cos — ~13 ops for the pair.
+    """
+    z = frac * frac
+    s = _SIN_COEFFS[-1]
+    for coef in _SIN_COEFFS[-2::-1]:
+        s = s * z + coef
+    s = s * frac
+    c = _COS_COEFFS[-1] * z + _COS_COEFFS[-2]
+    for coef in _COS_COEFFS[-3::-1]:
+        c = c * z + coef
+    return s, c

@@ -1,0 +1,138 @@
+"""Pallas TPU kernel for the uniform-grid Z^2 scan — the native-layer spike.
+
+The XLA fast path (ops/search.py::harmonic_sums_uniform) already removes
+most f64 work via the per-tile row decomposition; the roofline
+(docs/performance.md) says the remaining cost is VPU transcendentals and
+scan sequencing. This kernel owns both knobs explicitly:
+
+- the (trial_tile x event_chunk) phase tile lives in VMEM for its whole
+  lifetime (Pallas grid over (tile, event-chunk), output block revisited
+  along the event axis and accumulated in place);
+- sin/cos come from the fixed polynomial pair (ops/fasttrig.py) on the
+  mod-1-reduced argument — no libm range reduction;
+- harmonics use the same Chebyshev recurrence as the XLA kernels.
+
+Same decomposition as the XLA fast path: phase(j0 + j_lo, t) =
+frac(f_tile*t) + j_lo*frac(df*t), with the f64 part (one row per trial
+tile) precomputed OUTSIDE the kernel in chunks of ``tile_chunk`` tiles so
+HBM holds (tile_chunk x n_events) f32 rows, never the full grid.
+
+Status: correctness is pinned against the XLA kernels in
+tests/test_search.py (interpret mode on CPU); the on-chip A/B against the
+XLA fast path runs in the opportunistic TPU tier (tests/test_tpu_tier.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from crimp_tpu.ops import fasttrig
+from crimp_tpu.ops.search import chebyshev_weighted_sums
+
+TRIAL_TILE = 256
+EVENT_CHUNK = 1024
+TILE_CHUNK = 32  # trial tiles whose f64 base rows are materialized at once
+
+
+def _make_kernel(nharm: int, trial_tile: int):
+    def kernel(base_ref, b_ref, w_ref, c_ref, s_ref):
+        e = pl.program_id(1)
+        cb = base_ref[0, :]  # (EV,) f32, mod-1 reduced
+        b = b_ref[0, :]
+        w = w_ref[0, :]
+        j_lo = jax.lax.broadcasted_iota(jnp.float32, (trial_tile, 1), 0)
+        phase = cb[None, :] + j_lo * b[None, :]  # (T, EV)
+        frac = phase - jnp.round(phase)
+        sin1, cos1 = fasttrig.sincos_cycles(frac)
+        c_sums, s_sums = chebyshev_weighted_sums(cos1, sin1, w[None, :], nharm)  # (nharm, T)
+
+        @pl.when(e == 0)
+        def _():
+            c_ref[0] = c_sums
+            s_ref[0] = s_sums
+
+        @pl.when(e > 0)
+        def _():
+            c_ref[0] = c_ref[0] + c_sums
+            s_ref[0] = s_ref[0] + s_sums
+
+    return kernel
+
+
+@partial(
+    jax.jit,
+    static_argnames=("nharm", "trial_tile", "event_chunk", "interpret"),
+)
+def _tile_chunk_sums(
+    base, b, w, nharm: int, trial_tile: int, event_chunk: int, interpret: bool
+):
+    """(c, s) sums (k, nharm, trial_tile) for one chunk of k trial tiles."""
+    k, n_pad = base.shape
+    grid = (k, n_pad // event_chunk)
+    kernel = _make_kernel(nharm, trial_tile)
+    out_shape = jax.ShapeDtypeStruct((k, nharm, trial_tile), jnp.float32)
+    c, s = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, event_chunk), lambda i, e: (i, e)),
+            pl.BlockSpec((1, event_chunk), lambda i, e: (0, e)),
+            pl.BlockSpec((1, event_chunk), lambda i, e: (0, e)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, nharm, trial_tile), lambda i, e: (i, 0, 0)),
+            pl.BlockSpec((1, nharm, trial_tile), lambda i, e: (i, 0, 0)),
+        ),
+        out_shape=(out_shape, out_shape),
+        interpret=interpret,
+    )(base, b, w)
+    return c, s
+
+
+def z2_power_grid_pallas(
+    times,
+    f0: float,
+    df: float,
+    n_freq: int,
+    nharm: int = 2,
+    trial_tile: int = TRIAL_TILE,
+    event_chunk: int = EVENT_CHUNK,
+    tile_chunk: int = TILE_CHUNK,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Z^2_n over the uniform grid f0 + j*df via the Pallas tile kernel.
+
+    Drop-in comparable to ops.search.z2_power_grid (same statistic, f32
+    accumulation); ``interpret=True`` runs the kernel in the Pallas
+    interpreter for CPU correctness tests.
+    """
+    t64 = jnp.asarray(times, dtype=jnp.float64)
+    n = int(t64.shape[0])
+    n_pad = -(-n // event_chunk) * event_chunk
+    t_pad = jnp.pad(t64, (0, n_pad - n))
+    w = jnp.pad(jnp.ones(n, jnp.float32), (0, n_pad - n))[None, :]
+    b64 = df * t_pad
+    b = (b64 - jnp.round(b64)).astype(jnp.float32)[None, :]
+
+    n_tiles = -(-n_freq // trial_tile)
+    c_parts, s_parts = [], []
+    for chunk_start in range(0, n_tiles, tile_chunk):
+        k = min(tile_chunk, n_tiles - chunk_start)
+        f_tiles = f0 + (chunk_start + np.arange(k)) * (trial_tile * df)
+        base64 = jnp.asarray(f_tiles)[:, None] * t_pad[None, :]
+        base = (base64 - jnp.round(base64)).astype(jnp.float32)
+        c, s = _tile_chunk_sums(
+            base, b, w, nharm, trial_tile, event_chunk, interpret
+        )
+        c_parts.append(c)
+        s_parts.append(s)
+    c_all = jnp.concatenate(c_parts).astype(jnp.float64)  # (n_tiles, nharm, T)
+    s_all = jnp.concatenate(s_parts).astype(jnp.float64)
+    c_flat = jnp.moveaxis(c_all, 1, 0).reshape(nharm, -1)[:, :n_freq]
+    s_flat = jnp.moveaxis(s_all, 1, 0).reshape(nharm, -1)[:, :n_freq]
+    return jnp.sum((c_flat**2 + s_flat**2) * (2.0 / n), axis=0)

@@ -45,6 +45,8 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from crimp_tpu.ops import fasttrig
+
 DEFAULT_EVENT_BLOCK = 1 << 16
 DEFAULT_TRIAL_BLOCK = 256
 DEFAULT_TRIG_DTYPE = jnp.float32
@@ -95,40 +97,55 @@ def _block_times(times: jax.Array, block: int, weights: jax.Array | None = None)
 
 
 def _harmonic_sums_cycles(
-    phase_cycles: jax.Array, weights: jax.Array, nharm: int, trig_dtype=DEFAULT_TRIG_DTYPE
+    phase_cycles: jax.Array, weights: jax.Array, nharm: int,
+    trig_dtype=DEFAULT_TRIG_DTYPE, poly: bool = False,
 ):
     """(C_k, S_k) for k=1..nharm where C_k = sum_i w_i cos(2 pi k phi_i).
 
     ``phase_cycles``: (..., B) model phase in CYCLES (f64); the fractional
     part is extracted in f64, then trig + per-block sums run in
-    ``trig_dtype``. Returns f64 arrays of shape (nharm, ...).
+    ``trig_dtype``. ``poly`` swaps the hardware sin/cos for the fixed
+    polynomial pair on the already-reduced argument (ops/fasttrig.py).
+    Returns f64 arrays of shape (nharm, ...).
     """
     frac = phase_cycles - jnp.round(phase_cycles)
-    theta = (2 * np.pi) * frac.astype(trig_dtype)
     w = weights.astype(trig_dtype)
-    cos1 = jnp.cos(theta)
-    sin1 = jnp.sin(theta)
+    if poly:
+        sin1, cos1 = fasttrig.sincos_cycles(frac.astype(trig_dtype))
+    else:
+        theta = (2 * np.pi) * frac.astype(trig_dtype)
+        cos1 = jnp.cos(theta)
+        sin1 = jnp.sin(theta)
+    c_sums, s_sums = chebyshev_weighted_sums(cos1, sin1, w, nharm)
+    return c_sums.astype(jnp.float64), s_sums.astype(jnp.float64)
+
+
+def chebyshev_weighted_sums(cos1, sin1, weights, nharm: int):
+    """Weighted per-harmonic trig sums (nharm, ...) in the input dtype.
+
+    Harmonic k comes from the Chebyshev recurrence cos(k t) = 2 cos t
+    cos((k-1) t) - cos((k-2) t) (and its sine twin), so only the k=1
+    sin/cos pair is ever evaluated; summation is over the trailing axis.
+    Shared by the XLA kernels and the Pallas tile kernel.
+    """
     cos_km1, sin_km1 = cos1, sin1  # k-1 term
     cos_km2 = jnp.ones_like(cos1)  # k-2 term (k=0: cos=1, sin=0)
     sin_km2 = jnp.zeros_like(sin1)
-    c_list = [jnp.sum(w * cos1, axis=-1)]
-    s_list = [jnp.sum(w * sin1, axis=-1)]
+    c_list = [jnp.sum(weights * cos1, axis=-1)]
+    s_list = [jnp.sum(weights * sin1, axis=-1)]
     for _ in range(1, nharm):
         cos_k = 2 * cos1 * cos_km1 - cos_km2
         sin_k = 2 * cos1 * sin_km1 - sin_km2
-        c_list.append(jnp.sum(w * cos_k, axis=-1))
-        s_list.append(jnp.sum(w * sin_k, axis=-1))
+        c_list.append(jnp.sum(weights * cos_k, axis=-1))
+        s_list.append(jnp.sum(weights * sin_k, axis=-1))
         cos_km2, sin_km2 = cos_km1, sin_km1
         cos_km1, sin_km1 = cos_k, sin_k
-    return (
-        jnp.stack(c_list).astype(jnp.float64),
-        jnp.stack(s_list).astype(jnp.float64),
-    )
+    return jnp.stack(c_list), jnp.stack(s_list)
 
 
 def _blocked_trial_sums(
     times, freqs, nharm, event_block, trial_block, trig_dtype, phase_fn,
-    weights=None,
+    weights=None, poly: bool = False,
 ):
     """Trig sums (nharm, n_freq), blocked on BOTH the trial and event axes.
 
@@ -149,7 +166,7 @@ def _blocked_trial_sums(
         def step(carry, blk):
             t_blk, w_blk = blk
             phase = phase_fn(freq_blk, t_blk)  # cycles, f64
-            c, s = _harmonic_sums_cycles(phase, w_blk[None, :], nharm, trig_dtype)
+            c, s = _harmonic_sums_cycles(phase, w_blk[None, :], nharm, trig_dtype, poly)
             return (carry[0] + c, carry[1] + s), None
 
         # Anchoring the init to the traced operands keeps the carry's
@@ -166,7 +183,7 @@ def _blocked_trial_sums(
     return c_all, s_all
 
 
-@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype"))
+@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype", "poly"))
 def harmonic_sums_1d(
     times: jax.Array,
     freqs: jax.Array,
@@ -174,11 +191,13 @@ def harmonic_sums_1d(
     event_block: int = DEFAULT_EVENT_BLOCK,
     trial_block: int = DEFAULT_TRIAL_BLOCK,
     trig_dtype=DEFAULT_TRIG_DTYPE,
+    poly: bool = False,
 ):
     """Trig sums (nharm, n_freq) over all events, blockwise on both axes."""
     return _blocked_trial_sums(
         times, freqs, nharm, event_block, trial_block, trig_dtype,
         lambda f_blk, t_blk: f_blk[:, None] * t_blk[None, :],
+        poly=poly,
     )
 
 
@@ -187,7 +206,7 @@ def z2_from_sums(c_sum: jax.Array, s_sum: jax.Array, n_events) -> jax.Array:
     return (c_sum**2 + s_sum**2) * (2.0 / n_events)
 
 
-@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype"))
+@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype", "poly"))
 def z2_power(
     times: jax.Array,
     freqs: jax.Array,
@@ -195,13 +214,16 @@ def z2_power(
     event_block: int = DEFAULT_EVENT_BLOCK,
     trial_block: int = DEFAULT_TRIAL_BLOCK,
     trig_dtype=DEFAULT_TRIG_DTYPE,
+    poly: bool = False,
 ) -> jax.Array:
     """Z^2_n power at each frequency (times pre-centered by the caller)."""
-    c_sum, s_sum = harmonic_sums_1d(times, freqs, nharm, event_block, trial_block, trig_dtype)
+    c_sum, s_sum = harmonic_sums_1d(
+        times, freqs, nharm, event_block, trial_block, trig_dtype, poly
+    )
     return jnp.sum(z2_from_sums(c_sum, s_sum, times.shape[0]), axis=0)
 
 
-@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype"))
+@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype", "poly"))
 def h_power(
     times: jax.Array,
     freqs: jax.Array,
@@ -209,9 +231,12 @@ def h_power(
     event_block: int = DEFAULT_EVENT_BLOCK,
     trial_block: int = DEFAULT_TRIAL_BLOCK,
     trig_dtype=DEFAULT_TRIG_DTYPE,
+    poly: bool = False,
 ) -> jax.Array:
     """H-test power at each frequency: max_m (cumsum Z^2_m - 4(m-1))."""
-    c_sum, s_sum = harmonic_sums_1d(times, freqs, nharm, event_block, trial_block, trig_dtype)
+    c_sum, s_sum = harmonic_sums_1d(
+        times, freqs, nharm, event_block, trial_block, trig_dtype, poly
+    )
     z2_cum = jnp.cumsum(z2_from_sums(c_sum, s_sum, times.shape[0]), axis=0)
     penalties = 4.0 * jnp.arange(nharm, dtype=times.dtype)[:, None]
     return jnp.max(z2_cum - penalties, axis=0)
@@ -237,7 +262,7 @@ def uniform_grid(freqs: np.ndarray, rtol: float = 1e-12):
     return float(f[0]), float(df)
 
 
-@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block", "trial_block"))
+@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block", "trial_block", "poly"))
 def harmonic_sums_uniform(
     times: jax.Array,
     f0: float,
@@ -248,6 +273,7 @@ def harmonic_sums_uniform(
     trial_block: int = GRID_TRIAL_BLOCK,
     fdot: float | jax.Array = 0.0,
     weights: jax.Array | None = None,
+    poly: bool = False,
 ):
     """Trig sums over the uniform grid f0 + j*df — the f64-lean fast path.
 
@@ -283,7 +309,9 @@ def harmonic_sums_uniform(
             base = f_tile * t_blk + (0.5 * fdot) * t_blk**2
             cb = (base - jnp.round(base)).astype(jnp.float32)
             phase32 = cb[None, :] + j_lo[:, None] * b_blk[None, :]
-            c, s = _harmonic_sums_cycles(phase32, w_blk[None, :].astype(jnp.float32), nharm, jnp.float32)
+            c, s = _harmonic_sums_cycles(
+                phase32, w_blk[None, :].astype(jnp.float32), nharm, jnp.float32, poly
+            )
             return (carry[0] + c, carry[1] + s), None
 
         # Anchor the init to the traced operands so the carry's shard_map
@@ -309,10 +337,12 @@ def z2_power_grid(
     nharm: int = 2,
     event_block: int = GRID_EVENT_BLOCK,
     trial_block: int = GRID_TRIAL_BLOCK,
+    poly: bool = False,
 ) -> jax.Array:
     """Z^2_n over the uniform grid f0 + j*df (fast path; see above)."""
     c, s = harmonic_sums_uniform(
-        jnp.asarray(times), f0, df, n_freq, nharm, event_block, trial_block
+        jnp.asarray(times), f0, df, n_freq, nharm, event_block, trial_block,
+        poly=poly,
     )
     return jnp.sum(z2_from_sums(c, s, np.shape(times)[0]), axis=0)
 
@@ -325,17 +355,19 @@ def h_power_grid(
     nharm: int = 20,
     event_block: int = GRID_EVENT_BLOCK,
     trial_block: int = GRID_TRIAL_BLOCK,
+    poly: bool = False,
 ) -> jax.Array:
     """H-test over the uniform grid f0 + j*df (fast path)."""
     c, s = harmonic_sums_uniform(
-        jnp.asarray(times), f0, df, n_freq, nharm, event_block, trial_block
+        jnp.asarray(times), f0, df, n_freq, nharm, event_block, trial_block,
+        poly=poly,
     )
     z2_cum = jnp.cumsum(z2_from_sums(c, s, np.shape(times)[0]), axis=0)
     penalties = 4.0 * jnp.arange(nharm, dtype=jnp.float64)[:, None]
     return jnp.max(z2_cum - penalties, axis=0)
 
 
-@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block", "trial_block"))
+@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block", "trial_block", "poly"))
 def z2_power_2d_grid(
     times: jax.Array,
     f0: float,
@@ -345,6 +377,7 @@ def z2_power_2d_grid(
     nharm: int = 2,
     event_block: int = GRID_EVENT_BLOCK,
     trial_block: int = GRID_TRIAL_BLOCK,
+    poly: bool = False,
 ) -> jax.Array:
     """Z^2_n over the (fdot x uniform-frequency) grid -> (n_fdot, n_freq).
 
@@ -357,14 +390,15 @@ def z2_power_2d_grid(
 
     def one_fdot(fd):
         c, s = harmonic_sums_uniform(
-            times, f0, df, n_freq, nharm, event_block, trial_block, fdot=fd
+            times, f0, df, n_freq, nharm, event_block, trial_block, fdot=fd,
+            poly=poly,
         )
         return jnp.sum(z2_from_sums(c, s, n), axis=0)
 
     return jax.lax.map(one_fdot, jnp.asarray(fdots, dtype=jnp.float64))
 
 
-@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype"))
+@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype", "poly"))
 def z2_power_2d(
     times: jax.Array,
     freqs: jax.Array,
@@ -373,6 +407,7 @@ def z2_power_2d(
     event_block: int = DEFAULT_EVENT_BLOCK,
     trial_block: int = DEFAULT_TRIAL_BLOCK,
     trig_dtype=DEFAULT_TRIG_DTYPE,
+    poly: bool = False,
 ) -> jax.Array:
     """Z^2_n over the (fdot, freq) grid -> (n_fdot, n_freq).
 
@@ -385,6 +420,7 @@ def z2_power_2d(
             times, freqs, nharm, event_block, trial_block, trig_dtype,
             lambda f_blk, t_blk: f_blk[:, None] * t_blk[None, :]
             + 0.5 * fdot * t_blk[None, :] ** 2,
+            poly=poly,
         )
         return jnp.sum(z2_from_sums(c_sum, s_sum, times.shape[0]), axis=0)
 
@@ -425,12 +461,17 @@ class PeriodSearch:
     amortize the collectives.
     """
 
-    def __init__(self, time, freq, nbrHarm: int = 2, use_grid_fastpath: bool | None = None):
+    def __init__(self, time, freq, nbrHarm: int = 2, use_grid_fastpath: bool | None = None,
+                 poly_trig: bool | None = None):
         self.time = np.asarray(time, dtype=np.float64)
         self.freq = np.asarray(freq, dtype=np.float64)
         self.nbrHarm = int(nbrHarm)
         self.t0 = (self.time[0] + self.time[-1]) / 2
         self.use_grid_fastpath = use_grid_fastpath
+        self.poly_trig = poly_trig
+
+    def _poly(self) -> bool:
+        return fasttrig.poly_trig_enabled(self.poly_trig)
 
     def _centered(self) -> jax.Array:
         return jnp.asarray(self.time - self.t0)
@@ -458,15 +499,19 @@ class PeriodSearch:
 
             return pmesh.z2_sharded(
                 self.time - self.t0, self.freq, self.nbrHarm, mesh,
-                use_fastpath=self.use_grid_fastpath,
+                use_fastpath=self.use_grid_fastpath, poly=self._poly(),
             )
         grid = self._grid()
         if grid is not None:
             f0, df = grid
             return np.asarray(
-                z2_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm)
+                z2_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm,
+                              poly=self._poly())
             )
-        return np.asarray(z2_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm))
+        return np.asarray(
+            z2_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm,
+                     poly=self._poly())
+        )
 
     def htest(self) -> np.ndarray:
         mesh = self._mesh()
@@ -475,15 +520,19 @@ class PeriodSearch:
 
             return pmesh.h_sharded(
                 self.time - self.t0, self.freq, self.nbrHarm, mesh,
-                use_fastpath=self.use_grid_fastpath,
+                use_fastpath=self.use_grid_fastpath, poly=self._poly(),
             )
         grid = self._grid()
         if grid is not None:
             f0, df = grid
             return np.asarray(
-                h_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm)
+                h_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm,
+                             poly=self._poly())
             )
-        return np.asarray(h_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm))
+        return np.asarray(
+            h_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm,
+                    poly=self._poly())
+        )
 
     def twod_ztest(self, freq_dot):
         """2-D Z^2 on a (log10 |nudot|) grid, spin-down sign enforced.
@@ -499,14 +548,14 @@ class PeriodSearch:
 
             power = pmesh.z2_2d_sharded(
                 self.time - self.t0, self.freq, signed, self.nbrHarm, mesh,
-                use_fastpath=self.use_grid_fastpath,
+                use_fastpath=self.use_grid_fastpath, poly=self._poly(),
             )
         elif (grid := self._grid()) is not None:
             f0, df = grid
             power = np.asarray(
                 z2_power_2d_grid(
                     self._centered(), f0, df, len(self.freq),
-                    jnp.asarray(signed), self.nbrHarm,
+                    jnp.asarray(signed), self.nbrHarm, poly=self._poly(),
                 )
             )
         else:
@@ -516,6 +565,7 @@ class PeriodSearch:
                     jnp.asarray(self.freq),
                     jnp.asarray(signed),
                     self.nbrHarm,
+                    poly=self._poly(),
                 )
             )
         rows = np.column_stack(

@@ -135,7 +135,7 @@ def _pad_to(x: np.ndarray, multiple: int, fill=0.0):
 
 @partial(
     jax.jit,
-    static_argnames=("nharm", "mesh", "event_block", "trial_block", "trig_dtype"),
+    static_argnames=("nharm", "mesh", "event_block", "trial_block", "trig_dtype", "poly"),
 )
 def _sharded_sums_general(
     times,
@@ -147,6 +147,7 @@ def _sharded_sums_general(
     event_block: int = DEFAULT_EVENT_BLOCK,
     trial_block: int = DEFAULT_TRIAL_BLOCK,
     trig_dtype=None,
+    poly: bool = False,
 ):
     """Trig sums (n_fdot, nharm, n_freq): events sharded + psum-reduced,
     freqs sharded over the trial axis, blockwise streaming per shard."""
@@ -159,6 +160,7 @@ def _sharded_sums_general(
                 lambda f_blk, t_blk: f_blk[:, None] * t_blk[None, :]
                 + (0.5 * fd) * t_blk[None, :] ** 2,
                 weights=w_shard,
+                poly=poly,
             )
 
         # All per-fdot partials first, then ONE stacked all-reduce: a single
@@ -177,7 +179,7 @@ def _sharded_sums_general(
 
 @partial(
     jax.jit,
-    static_argnames=("n_freq", "nharm", "mesh", "event_block", "trial_block"),
+    static_argnames=("n_freq", "nharm", "mesh", "event_block", "trial_block", "poly"),
 )
 def _sharded_sums_grid(
     times,
@@ -190,6 +192,7 @@ def _sharded_sums_grid(
     mesh: Mesh,
     event_block: int = GRID_EVENT_BLOCK,
     trial_block: int = GRID_TRIAL_BLOCK,
+    poly: bool = False,
 ):
     """Uniform-grid fast-path trig sums under sharding.
 
@@ -207,7 +210,7 @@ def _sharded_sums_grid(
         def one_fd(fd):
             return harmonic_sums_uniform(
                 t_shard, f0_shard, df, n_freq_shard, nharm,
-                event_block, trial_block, fdot=fd, weights=w_shard,
+                event_block, trial_block, fdot=fd, weights=w_shard, poly=poly,
             )
 
         c_all, s_all = jax.lax.map(one_fd, fd_all)
@@ -230,7 +233,8 @@ def _fit_block(default: int, per_shard: int) -> int:
     return block
 
 
-def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath):
+def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath,
+                     poly: bool = False):
     """(c, s) trig sums of shape (n_fdot, nharm, n_freq) with host-side
     padding to the mesh tiling; dispatches grid fast path vs general."""
     ev_size = mesh.shape[EVENT_AXIS]
@@ -251,6 +255,7 @@ def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath)
             jnp.asarray(t_pad), jnp.asarray(w_pad), f0, df, n_freq_pad, fd, nharm, mesh,
             event_block=_fit_block(GRID_EVENT_BLOCK, ev_per_shard),
             trial_block=_fit_block(GRID_TRIAL_BLOCK, tr_per_shard),
+            poly=poly,
         )
     else:
         f_pad, _ = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
@@ -259,29 +264,30 @@ def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath)
             nharm, mesh, trig_dtype=trig_dtype,
             event_block=_fit_block(DEFAULT_EVENT_BLOCK, ev_per_shard),
             trial_block=_fit_block(DEFAULT_TRIAL_BLOCK, tr_per_shard),
+            poly=poly,
         )
     return c[:, :, :n_freq], s[:, :, :n_freq]
 
 
 def z2_sharded(
     times, freqs, nharm: int = 2, mesh: Mesh | None = None, trig_dtype=None,
-    use_fastpath: bool | None = None,
+    use_fastpath: bool | None = None, poly: bool = False,
 ) -> np.ndarray:
     """Z^2_n over the frequency grid, events sharded across the mesh."""
     if mesh is None:
         mesh = build_mesh()
-    c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype, use_fastpath)
+    c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype, use_fastpath, poly)
     return np.asarray(jnp.sum(z2_from_sums(c[0], s[0], len(times)), axis=0))
 
 
 def h_sharded(
     times, freqs, nharm: int = 20, mesh: Mesh | None = None, trig_dtype=None,
-    use_fastpath: bool | None = None,
+    use_fastpath: bool | None = None, poly: bool = False,
 ) -> np.ndarray:
     """H-test over the frequency grid, events sharded across the mesh."""
     if mesh is None:
         mesh = build_mesh()
-    c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype, use_fastpath)
+    c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype, use_fastpath, poly)
     z2_cum = jnp.cumsum(z2_from_sums(c[0], s[0], len(times)), axis=0)
     penalties = 4.0 * jnp.arange(nharm)[:, None]
     return np.asarray(jnp.max(z2_cum - penalties, axis=0))
@@ -289,14 +295,14 @@ def h_sharded(
 
 def z2_2d_sharded(
     times, freqs, fdots, nharm: int = 2, mesh: Mesh | None = None, trig_dtype=None,
-    use_fastpath: bool | None = None,
+    use_fastpath: bool | None = None, poly: bool = False,
 ) -> np.ndarray:
     """Z^2_n over the (fdot, freq) grid -> (n_fdot, n_freq), events sharded
     across the mesh with psum combines (fdots replicated; the frequency axis
     shards over the trial mesh axis)."""
     if mesh is None:
         mesh = build_mesh()
-    c, s = _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath)
+    c, s = _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath, poly)
     return np.asarray(jnp.sum(z2_from_sums(c, s, len(times)), axis=1))
 
 

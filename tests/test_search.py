@@ -195,6 +195,93 @@ class TestUniformGridFastPath:
         assert abs(ps.freq[int(np.argmax(power))] - 0.25) < 5e-5
 
 
+class TestPolyTrig:
+    def test_sincos_accuracy_on_reduced_range(self):
+        """The fixed polynomials must stay within their documented bounds
+        (3.1e-7 sin / 3.6e-8 cos) over the full reduced argument range."""
+        import jax.numpy as jnp
+
+        from crimp_tpu.ops import fasttrig
+
+        x = np.linspace(-0.5, 0.5, 400001)
+        s, c = fasttrig.sincos_cycles(jnp.asarray(x))  # f64 here: bounds the
+        # polynomial itself, not f32 rounding
+        assert np.max(np.abs(np.asarray(s) - np.sin(2 * np.pi * x))) < 3.2e-7
+        assert np.max(np.abs(np.asarray(c) - np.cos(2 * np.pi * x))) < 4.0e-8
+
+    def test_env_and_override_resolution(self, monkeypatch):
+        from crimp_tpu.ops import fasttrig
+
+        monkeypatch.delenv("CRIMP_TPU_POLY_TRIG", raising=False)
+        assert not fasttrig.poly_trig_enabled()
+        assert fasttrig.poly_trig_enabled(True)
+        monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "1")
+        assert fasttrig.poly_trig_enabled()
+        assert not fasttrig.poly_trig_enabled(False)
+
+    def test_z2_poly_matches_hardware_trig(self, sim_events, monkeypatch):
+        """Statistic parity: the poly-trig scan must agree with the hardware
+        f32-trig scan to far below the statistic's noise, through the
+        PeriodSearch entry (both fast path and general kernel)."""
+        monkeypatch.setenv("CRIMP_TPU_SHARD", "0")
+        freqs = np.linspace(0.2495, 0.2505, 256)
+        hw = search.PeriodSearch(sim_events, freqs, 2, poly_trig=False).ztest()
+        poly = search.PeriodSearch(sim_events, freqs, 2, poly_trig=True).ztest()
+        np.testing.assert_allclose(poly, hw, rtol=1e-4, atol=1e-2)
+        assert int(np.argmax(poly)) == int(np.argmax(hw))
+        # general (non-uniform grid) kernel too
+        jagged = np.concatenate([freqs[:100], freqs[100:] + 1.7e-9])
+        hw_g = search.PeriodSearch(sim_events, jagged, 2, poly_trig=False).ztest()
+        poly_g = search.PeriodSearch(sim_events, jagged, 2, poly_trig=True).ztest()
+        np.testing.assert_allclose(poly_g, hw_g, rtol=1e-4, atol=1e-2)
+
+    def test_htest_poly_high_nharm(self, sim_events, monkeypatch):
+        """Chebyshev recurrence on poly-trig values stays accurate at the
+        default H-test order."""
+        monkeypatch.setenv("CRIMP_TPU_SHARD", "0")
+        freqs = np.linspace(0.2495, 0.2505, 64)
+        hw = search.PeriodSearch(sim_events, freqs, 20, poly_trig=False).htest()
+        poly = search.PeriodSearch(sim_events, freqs, 20, poly_trig=True).htest()
+        np.testing.assert_allclose(poly, hw, rtol=2e-3, atol=0.2)
+
+
+class TestPallasZ2:
+    def test_interpret_matches_xla_fast_path(self, sim_events):
+        """The Pallas tile kernel (interpret mode on CPU) must reproduce the
+        XLA fast-path statistic; on-chip A/B runs in the TPU tier."""
+        from crimp_tpu.ops.pallas_z2 import z2_power_grid_pallas
+
+        sec = sim_events - sim_events.mean()
+        n_freq = 300  # not a tile multiple: exercises tail truncation
+        freqs = np.linspace(0.2495, 0.2505, n_freq)
+        f0, df = search.uniform_grid(freqs)
+        xla = np.asarray(search.z2_power_grid(sec, f0, df, n_freq, 2))
+        pallas = np.asarray(
+            z2_power_grid_pallas(sec, f0, df, n_freq, 2, interpret=True)
+        )
+        assert pallas.shape == (n_freq,)
+        np.testing.assert_allclose(pallas, xla, rtol=2e-3, atol=0.05)
+        assert int(np.argmax(pallas)) == int(np.argmax(xla))
+
+    def test_interpret_multi_tile_chunks(self, sim_events):
+        """More trial tiles than one chunk: the chunked f64 base-row
+        precompute must stitch tiles together in grid order."""
+        from crimp_tpu.ops import pallas_z2
+
+        sec = (sim_events - sim_events.mean())[:4096]
+        n_freq = 1100
+        freqs = np.linspace(0.24, 0.26, n_freq)
+        f0, df = search.uniform_grid(freqs)
+        xla = np.asarray(search.z2_power_grid(sec, f0, df, n_freq, 3))
+        got = np.asarray(
+            pallas_z2.z2_power_grid_pallas(
+                sec, f0, df, n_freq, 3, trial_tile=128, event_chunk=512,
+                tile_chunk=4, interpret=True,
+            )
+        )
+        np.testing.assert_allclose(got, xla, rtol=5e-3, atol=0.1)
+
+
 class TestHPowerSegments:
     def test_pins_reference_per_toa_htest(self):
         """The batched per-segment H backing the ToA table must equal the

@@ -157,6 +157,57 @@ class TestOnChipToABatch:
         assert result["c_trig_ops_equiv"] < 400
         print(f"C_trig (FMA-op equivalents per sin/cos): {result['c_trig_ops_equiv']:.1f}")
 
+    def test_pallas_and_polytrig_ab_vs_xla_fast_path(self):
+        """On-chip A/B at bench scale: XLA fast path (hardware trig) vs XLA
+        fast path (poly trig) vs the Pallas tile kernel. Reports throughputs
+        for docs/performance.md and pins statistic agreement."""
+        result = run_on_chip(
+            """
+            import json, time
+            import numpy as np
+            import jax.numpy as jnp
+            from crimp_tpu.ops import search
+            from crimp_tpu.ops.pallas_z2 import z2_power_grid_pallas
+
+            rng = np.random.RandomState(7)
+            sec = np.sort(rng.uniform(-4e5, 4e5, 800000))
+            n_trials = 100000
+            freqs = np.linspace(0.1430, 0.1436, n_trials)
+            f0, df = search.uniform_grid(freqs)
+
+            def rate(fn):
+                fn().block_until_ready()
+                best = np.inf
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    fn().block_until_ready()
+                    best = min(best, time.perf_counter() - t0)
+                return n_trials / best
+
+            hw = lambda: search.z2_power_grid(sec, f0, df, n_trials, 2)
+            poly = lambda: search.z2_power_grid(sec, f0, df, n_trials, 2, poly=True)
+            pallas = lambda: z2_power_grid_pallas(sec, f0, df, n_trials, 2)
+            r_hw, r_poly, r_pallas = rate(hw), rate(poly), rate(pallas)
+            a, b, c = (np.asarray(f()) for f in (hw, poly, pallas))
+            denom = np.maximum(a, 1.0)
+            print(json.dumps({
+                "trials_per_sec_hw": r_hw,
+                "trials_per_sec_poly": r_poly,
+                "trials_per_sec_pallas": r_pallas,
+                "poly_max_rel_dev": float(np.max(np.abs(b - a) / denom)),
+                "pallas_max_rel_dev": float(np.max(np.abs(c - a) / denom)),
+            }))
+            """,
+            timeout=1800.0,
+        )
+        assert result["poly_max_rel_dev"] < 5e-3
+        assert result["pallas_max_rel_dev"] < 2e-2
+        print(
+            f"Z2 trials/s — hw: {result['trials_per_sec_hw']:.0f}, "
+            f"poly: {result['trials_per_sec_poly']:.0f}, "
+            f"pallas: {result['trials_per_sec_pallas']:.0f}"
+        )
+
     def test_fastpath_vs_f64_bound_1e5_trials(self):
         """On-chip fast-path Z^2 must stay within the documented deviation
         bound of the all-f64 path at the bench scale (1e5 trials)."""
