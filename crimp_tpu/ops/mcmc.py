@@ -32,6 +32,38 @@ def ensemble_sample(
 
     chain: (steps, walkers, ndim); log_probs: (steps, walkers).
     """
+    return _ensemble_core(log_prob_fn, p0, steps, key, stretch_a)
+
+
+@partial(jax.jit, static_argnames=("log_prob_fn", "steps"))
+def ensemble_sample_batch(
+    log_prob_fn,
+    p0: jax.Array,  # (B, walkers, ndim) per-problem initial ensembles
+    data,  # pytree with leading axis B: per-problem observations
+    steps: int,
+    key: jax.Array,
+    stretch_a: float = 2.0,
+):
+    """Independent ensembles vmapped over a batch of problems.
+
+    ``log_prob_fn(theta, data_b)`` scores one walker of problem b. This is
+    the vmap-over-windows device program of SURVEY §3.5 (the reference runs
+    one emcee per sliding window, get_local_ephem.py:104-239): every
+    window/segment samples in parallel in ONE compiled call. Returns
+    (chain (B, steps, walkers, ndim), log_probs (B, steps, walkers)).
+    """
+    n_batch = p0.shape[0]
+    keys = jax.random.split(key, n_batch)
+
+    def one(p0_b, data_b, key_b):
+        return _ensemble_core(
+            lambda theta: log_prob_fn(theta, data_b), p0_b, steps, key_b, stretch_a
+        )
+
+    return jax.vmap(one)(p0, data, keys)
+
+
+def _ensemble_core(log_prob_fn, p0, steps: int, key, stretch_a: float):
     n_walkers, ndim = p0.shape
     half = n_walkers // 2
     lp0 = jax.vmap(log_prob_fn)(p0)

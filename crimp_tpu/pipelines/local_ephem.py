@@ -8,8 +8,12 @@ F0/F1 with the ensemble MCMC under span-scaled box priors, record
 F0, F1 +/- err and chi2; finally detrend F0 by the global F0+F1 trend and
 write the CSV + plot.
 
-The MCMC is the pure-JAX sampler (ops.mcmc): each window's 1000-step,
-24-walker run is one device program.
+TPU re-design (SURVEY §3.5: "windows are independent given glitch
+boundaries -> vmap over windows", BASELINE config 4): window DISCOVERY is
+data-dependent host logic and stays a host loop, but every window's
+1000-step ensemble run executes together in ONE batched device program
+(ops.mcmc.ensemble_sample_batch, ToAs padded/masked per window) — the
+reference runs one serial emcee per window (get_local_ephem.py:195-198).
 """
 
 from __future__ import annotations
@@ -19,14 +23,80 @@ import pandas as pd
 
 from crimp_tpu.io import parfile as parfile_io
 from crimp_tpu.io import tim as tim_io
-from crimp_tpu.io.yamlcfg import Prior
 from crimp_tpu.models import timing
+from crimp_tpu.ops import mcmc as mcmc_ops
 from crimp_tpu.ops.ephem import integer_rotation_host
 from crimp_tpu.pipelines import fit_utils
-from crimp_tpu.pipelines.fit_toas import load_toas_for_fit, plot_residuals, run_mcmc
+from crimp_tpu.pipelines.fit_toas import corner_plot, load_toas_for_fit, plot_residuals
 from crimp_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
+
+FIT_KEYS = ["F0", "F1"]
+
+
+def _window_log_prob(theta, data):
+    """Delta-parameterized local model: mu = d0*dt + d1*dt^2/2 (seconds from
+    the window anchor), mean-subtracted over valid ToAs — the 2-free-param
+    specialization of fit_toas.make_logprob, masked for padding."""
+    import jax.numpy as jnp
+
+    dt, y, err, mask, lo, hi = (
+        data["dt"], data["y"], data["err"], data["mask"], data["lo"], data["hi"]
+    )
+    in_box = jnp.all((theta > lo) & (theta < hi))
+    mu = theta[0] * dt + 0.5 * theta[1] * dt**2
+    mu = mu - jnp.sum(mu * mask) / jnp.sum(mask)
+    resid = (y - mu) / err
+    nll = 0.5 * jnp.sum(mask * (resid**2 + jnp.log(2 * jnp.pi * err**2)))
+    return jnp.where(in_box, -nll, -jnp.inf)
+
+
+def _fit_windows_batched(windows: list[dict], steps: int, burn: int, walkers: int,
+                         debug_with_plots: bool):
+    """One batched ensemble run over all discovered windows; returns the
+    per-window posterior summaries in window order."""
+    import jax
+    import jax.numpy as jnp
+
+    n_max = max(len(w["dt_sec"]) for w in windows)
+    W = len(windows)
+    dt = np.zeros((W, n_max))
+    y = np.zeros((W, n_max))
+    err = np.ones((W, n_max))
+    mask = np.zeros((W, n_max))
+    lo = np.zeros((W, 2))
+    hi = np.zeros((W, 2))
+    p0 = np.empty((W, walkers, 2))
+    for i, w in enumerate(windows):
+        n = len(w["dt_sec"])
+        dt[i, :n] = w["dt_sec"]
+        y[i, :n] = w["phase"]
+        err[i, :n] = w["phase_err"]
+        mask[i, :n] = 1.0
+        lo[i], hi[i] = w["lo"], w["hi"]
+        rng = np.random.default_rng(w["seed"])
+        for d in range(2):
+            p0[i, :, d] = rng.uniform(lo[i, d], hi[i, d], size=walkers)
+
+    data = {
+        "dt": jnp.asarray(dt), "y": jnp.asarray(y), "err": jnp.asarray(err),
+        "mask": jnp.asarray(mask), "lo": jnp.asarray(lo), "hi": jnp.asarray(hi),
+    }
+    chains, lps = mcmc_ops.ensemble_sample_batch(
+        _window_log_prob, jnp.asarray(p0), data, steps, jax.random.PRNGKey(0)
+    )
+    chains = np.asarray(chains)
+    lps = np.asarray(lps)
+    out = []
+    for i, w in enumerate(windows):
+        flat, _, summaries = mcmc_ops.summarize_chain(
+            chains[i], lps[i], FIT_KEYS, burn=max(0, burn)
+        )
+        if debug_with_plots:
+            corner_plot(flat, FIT_KEYS, f"corner_interval_{w['seed']}")
+        out.append(summaries)
+    return out
 
 
 def generate_local_ephemerides(
@@ -66,6 +136,7 @@ def generate_local_ephemerides(
     tm = timing.resolve(parfile)
     current_start = t_start
     records = []
+    windows_found: list[dict] = []
     eps = 1e-5
     window_counter = 0
 
@@ -112,52 +183,64 @@ def generate_local_ephemerides(
             }
             local_par["TRACK"] = -2
 
-            fit_keys = fit_utils.list_fit_keys(local_par)
             span_sec = span_days * 86400.0
-            prior = Prior(
-                bounds={
-                    "F0": (-100 / span_sec, 100 / span_sec),
-                    "F1": (-100 / span_sec**2, 100 / span_sec**2),
-                },
-                initial_guess={},
-            )
             toas_to_fit = load_toas_for_fit(window, local_par)
-            _, _, summaries = run_mcmc(
-                toas_to_fit["ToA"], toas_to_fit["phase"], toas_to_fit["phase_err_cycle"],
-                local_par, fit_keys, prior,
-                steps=mcmc_steps, burn=mcmc_burn, walkers=mcmc_walkers,
-                corner_pdf=(f"corner_interval_{window_counter}" if debug_with_plots else None),
-                seed=window_counter,
-            )
-            med_vec = np.array([summaries[k]["median"] for k in fit_keys])
-            _, full_dict = fit_utils.inject_free_params(local_par, med_vec, fit_keys)
-            post_fit = fit_utils.model_phase_residuals(
-                toas_to_fit["ToA"].to_numpy(), local_par, med_vec, fit_keys
-            )
-            if debug_with_plots:
-                plot_residuals(toas_to_fit, post_fit, plotname=f"residuals_interval_{window_counter}")
-            window_counter += 1
-
-            stats = fit_utils.chi2_fit(
-                toas_to_fit["phase"], post_fit, toas_to_fit["phase_err_cycle"], 2
-            )
-            records.append(
+            y = toas_to_fit["phase"].to_numpy(dtype=float)
+            windows_found.append(
                 {
-                    "TOA_MJD_ref": mid_anchor,
-                    "TOA_MJD_ref_err": span_days / 2.0,
-                    "F0": full_dict["F0"],
-                    "F0_err": max(summaries["F0"]["plus"], summaries["F0"]["minus"]),
-                    "F1": full_dict["F1"],
-                    "F1_err": max(summaries["F1"]["plus"], summaries["F1"]["minus"]),
-                    "CHI2R": stats["redchi2"],
-                    "DOF": stats["dof"],
+                    "seed": window_counter,
+                    "mid_anchor": mid_anchor,
+                    "span_days": span_days,
+                    "local_par": local_par,
+                    "toas_to_fit": toas_to_fit,
+                    "dt_sec": (toas_to_fit["ToA"].to_numpy(dtype=float) - mid_anchor)
+                    * 86400.0,
+                    "phase": y,  # already mean-subtracted by load_toas_for_fit
+                    "phase_err": toas_to_fit["phase_err_cycle"].to_numpy(dtype=float),
+                    "lo": np.array([-100 / span_sec, -100 / span_sec**2]),
+                    "hi": np.array([100 / span_sec, 100 / span_sec**2]),
                 }
             )
+            window_counter += 1
 
         if crossing_glitch is not None:
             current_start = crossing_glitch + eps
         else:
             current_start += jump_days
+
+    # ---- all windows sample together in one batched device program -------
+    all_summaries = (
+        _fit_windows_batched(
+            windows_found, mcmc_steps, mcmc_burn, mcmc_walkers, debug_with_plots
+        )
+        if windows_found
+        else []
+    )
+    for w, summaries in zip(windows_found, all_summaries):
+        med_vec = np.array([summaries[k]["median"] for k in FIT_KEYS])
+        _, full_dict = fit_utils.inject_free_params(w["local_par"], med_vec, FIT_KEYS)
+        post_fit = fit_utils.model_phase_residuals(
+            w["toas_to_fit"]["ToA"].to_numpy(), w["local_par"], med_vec, FIT_KEYS
+        )
+        if debug_with_plots:
+            plot_residuals(
+                w["toas_to_fit"], post_fit, plotname=f"residuals_interval_{w['seed']}"
+            )
+        stats = fit_utils.chi2_fit(
+            w["toas_to_fit"]["phase"], post_fit, w["toas_to_fit"]["phase_err_cycle"], 2
+        )
+        records.append(
+            {
+                "TOA_MJD_ref": w["mid_anchor"],
+                "TOA_MJD_ref_err": w["span_days"] / 2.0,
+                "F0": full_dict["F0"],
+                "F0_err": max(summaries["F0"]["plus"], summaries["F0"]["minus"]),
+                "F1": full_dict["F1"],
+                "F1_err": max(summaries["F1"]["plus"], summaries["F1"]["minus"]),
+                "CHI2R": stats["redchi2"],
+                "DOF": stats["dof"],
+            }
+        )
 
     if not records:
         logger.warning(
