@@ -1,0 +1,55 @@
+#!/bin/bash
+# One serialized on-chip session: run everything that has been waiting for
+# the accelerator relay, strictly one JAX process at a time (the relay
+# serves a single client; see docs/performance.md and the TPU test tier).
+#
+# Usage: bash scripts/onchip_session.sh [outdir]
+# Each stage logs to <outdir>/<stage>.log and the JSON results aggregate in
+# <outdir>/results.jsonl. Stages continue on failure (a late wedge must not
+# discard earlier results).
+
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-onchip_results}"
+mkdir -p "$OUT"
+RESULTS="$OUT/results.jsonl"
+: > "$RESULTS"
+
+stage() {
+    local name="$1"; shift
+    echo "=== [$name] $(date -u +%H:%M:%S) ===" | tee -a "$OUT/session.log"
+    ( "$@" ) > "$OUT/$name.log" 2>&1
+    local rc=$?
+    echo "{\"stage\": \"$name\", \"rc\": $rc}" >> "$RESULTS"
+    echo "=== [$name] rc=$rc ===" | tee -a "$OUT/session.log"
+    return 0
+}
+
+# 0) quick health check: if the relay is wedged, stop before burning hours
+python - <<'EOF' > "$OUT/health.log" 2>&1
+import jax
+print(jax.devices())
+EOF
+if [ $? -ne 0 ]; then
+    echo '{"stage": "health", "rc": 1}' >> "$RESULTS"
+    echo "relay unhealthy; aborting session" | tee -a "$OUT/session.log"
+    exit 1
+fi
+echo '{"stage": "health", "rc": 0}' >> "$RESULTS"
+
+# 1) opportunistic TPU test tier (C_trig micro, hw/poly/Pallas A/B,
+#    full-res ToA batch, fast-path-vs-f64 bound)
+stage tpu_tier env CRIMP_TPU_RUN_TPU_TESTS=1 python -m pytest tests/test_tpu_tier.py -m tpu -q -s
+
+# 2) ToAFitConfig sweep at the real shape (defaults decision)
+stage tune_toafit python scripts/tune_toafit.py
+
+# 3) BASELINE scale configs 3 and 5 at full scale
+stage config3 python scripts/run_scale_configs.py --config 3
+stage config5 python scripts/run_scale_configs.py --config 5
+
+# 4) the official bench workload on the chip
+stage bench python bench.py
+
+echo "=== session done $(date -u +%H:%M:%S) ===" | tee -a "$OUT/session.log"
+cat "$RESULTS"
