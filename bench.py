@@ -226,17 +226,50 @@ def bench_z2(times: np.ndarray, n_trials: int = 100_000) -> dict:
     t0 = time.perf_counter()
     power = np.asarray(search.z2_power_grid(sec, f0, df, n_trials, 2))
     wall = time.perf_counter() - t0
-    return {
+    out = {
         "wall_s": wall,
         "trials_per_sec": n_trials / wall,
         "n_events": len(sec),
         "peak": float(power.max()),
         "peak_freq": float(freqs[int(np.argmax(power))]),
+        "trials_per_sec_poly": None,
+        "rel_dev_poly": None,
+        "trials_per_sec_pallas": None,
+        "rel_dev_pallas": None,
     }
+
+    # A/B the two transcendental-roofline levers on the same scan so the
+    # official record carries both throughput AND deviation; each is
+    # best-effort (a kernel that fails to compile on some backend must not
+    # zero the bench).
+    def ab(label: str, key: str, fn) -> None:
+        try:
+            np.asarray(fn())  # compile
+            t0 = time.perf_counter()
+            alt_power = np.asarray(fn())
+            out[f"trials_per_sec_{key}"] = n_trials / (time.perf_counter() - t0)
+            out[f"rel_dev_{key}"] = float(
+                np.max(np.abs(alt_power - power) / np.maximum(power, 1.0))
+            )
+            log(f"[bench] {label} Z^2: {out[f'trials_per_sec_{key}']:.0f} trials/s "
+                f"(max rel dev {out[f'rel_dev_{key}']:.2e})")
+        except Exception as exc:  # noqa: BLE001 - record and continue
+            log(f"[bench] {label} Z^2 skipped: {type(exc).__name__}: {str(exc)[:200]}")
+
+    ab("poly-trig", "poly",
+       lambda: search.z2_power_grid(sec, f0, df, n_trials, 2, poly=True))
+
+    def pallas_run():
+        from crimp_tpu.ops.pallas_z2 import z2_power_grid_pallas
+
+        return z2_power_grid_pallas(sec, f0, df, n_trials, 2)
+
+    ab("Pallas", "pallas", pallas_run)
+    return out
 
 
 def bench_north_star(par_path: str, template_path: str, times: np.ndarray, intervals,
-                     n_freq: int = 2500, n_fdot: int = 40) -> dict:
+                     n_freq: int = 2500, n_fdot: int = 40, poly_trig: bool = False) -> dict:
     """The BASELINE north star as ONE wall clock: full 2-D (nu, nudot) Z^2
     scan (1e5 trials: 2500 nu x 40 nudot) + the 84-ToA extraction on the
     bundled-campaign surrogate. Target <10 s."""
@@ -261,7 +294,7 @@ def bench_north_star(par_path: str, template_path: str, times: np.ndarray, inter
 
     def run_once():
         # --- 2-D periodicity scan (PeriodSearch CLI semantics) ------------
-        ps = search.PeriodSearch(sec, freqs, 2)
+        ps = search.PeriodSearch(sec, freqs, 2, poly_trig=poly_trig)
         rows, _ = ps.twod_ztest(log_fdots)
         # --- ToA extraction over the committed 84 intervals ----------------
         toa_mids = np.zeros(len(intervals))
@@ -404,9 +437,21 @@ def main():
         f"median H {toas['median_H']:.0f})")
     log(f"[bench] reference: {REFERENCE_TOAS_PER_SEC:.4f} ToA/s (202 s for 84 ToAs, data/ToAs_2259.log)")
 
-    north = bench_north_star(par, template, times, intervals, n_freq=ns_freq, n_fdot=ns_fdot)
+    # the scan half of the north star uses whichever trig path the A/B just
+    # measured faster — but only if its measured deviation on this very
+    # workload stayed inside the accuracy budget (never trade correctness
+    # for the headline number)
+    use_poly = bool(
+        z2["trials_per_sec_poly"]
+        and z2["trials_per_sec_poly"] > 1.2 * z2["trials_per_sec"]
+        and z2["rel_dev_poly"] is not None
+        and z2["rel_dev_poly"] < 1e-3
+    )
+    north = bench_north_star(par, template, times, intervals, n_freq=ns_freq,
+                             n_fdot=ns_fdot, poly_trig=use_poly)
     log(f"[bench] NORTH STAR one-run: 2-D Z^2 {north['n_trials_2d']} trials + "
-        f"{north['n_toas']} ToAs in {north['wall_s']:.2f}s (target <10s); "
+        f"{north['n_toas']} ToAs in {north['wall_s']:.2f}s (target <10s, "
+        f"{'poly' if use_poly else 'hw'} trig); "
         f"peak Z^2 {north['peak_z2']:.0f} at {north['peak_freq']:.6f} Hz")
 
     cfg4 = bench_config4(template, n_segments=cfg4_segments, events_per_seg=cfg4_events)
@@ -422,9 +467,18 @@ def main():
         "platform": platform,
         "cpu_scaled_workloads": on_cpu,
         "north_star_trials": north["n_trials_2d"],
+        "north_star_poly_trig": use_poly,
         "north_star_wall_s": round(north["wall_s"], 3),
         "north_star_under_10s": (north["wall_s"] < 10.0) and not on_cpu,
         "z2_trials_per_sec": round(z2["trials_per_sec"], 1),
+        "z2_trials_per_sec_poly": (
+            round(z2["trials_per_sec_poly"], 1) if z2["trials_per_sec_poly"] else None
+        ),
+        "z2_rel_dev_poly": z2["rel_dev_poly"],
+        "z2_trials_per_sec_pallas": (
+            round(z2["trials_per_sec_pallas"], 1) if z2["trials_per_sec_pallas"] else None
+        ),
+        "z2_rel_dev_pallas": z2["rel_dev_pallas"],
         "config4_n_segments": cfg4["n_segments"],
         "config4_wall_s": round(cfg4["wall_s"], 3),
         "config4_toas_per_sec": round(cfg4["toas_per_sec"], 1),

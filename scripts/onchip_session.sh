@@ -26,7 +26,9 @@ stage() {
 }
 
 # 0) quick health check: if the relay is wedged, stop before burning hours
-python - <<'EOF' > "$OUT/health.log" 2>&1
+# (a wedged relay HANGS rather than erroring, so the timeout is what makes
+# this check able to fire; healthy cold handshake is well under 5 min)
+timeout 300 python - <<'EOF' > "$OUT/health.log" 2>&1
 import jax
 print(jax.devices())
 EOF
