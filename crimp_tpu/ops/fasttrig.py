@@ -12,10 +12,14 @@ evaluate sin(2*pi*x) and cos(2*pi*x) directly on the reduced argument in
 
 — a few times the hardware path's own f32 output rounding (~6e-8), but
 two orders below the ~1e-5-cycle phase error both paths already carry and
-far below the statistic's sqrt(N) noise floor. Opt-in via ``CRIMP_TPU_POLY_TRIG=1`` (or the ``poly_trig`` argument of
-``PeriodSearch``); the on-chip win depends on the hardware's native
-transcendental cost (docs/performance.md "Z^2 roofline" — the C_trig
-microbenchmark in tests/test_tpu_tier.py decides).
+far below the statistic's sqrt(N) noise floor.
+
+Default: ON when the default JAX backend is a TPU, OFF elsewhere — the
+round-3 on-chip A/B (v5e, 1e5 trials x 8.4e5 events) measured 91.5k vs
+33.2k trials/s (2.76x) at 3.2e-4 max relative deviation on the statistic
+(docs/performance.md "Z^2 roofline"). Override per-call with the
+``poly_trig`` argument of ``PeriodSearch`` or globally with
+``CRIMP_TPU_POLY_TRIG=1``/``0``.
 """
 
 from __future__ import annotations
@@ -44,12 +48,21 @@ _COS_COEFFS = (
 
 
 def poly_trig_enabled(override: bool | None = None) -> bool:
-    """Whether search kernels should use the polynomial sin/cos pair."""
+    """Whether search kernels should use the polynomial sin/cos pair.
+
+    Precedence: explicit ``override`` > ``CRIMP_TPU_POLY_TRIG`` env var >
+    backend auto-default (on for TPU, off for CPU/GPU).
+    """
     if override is not None:
         return bool(override)
-    return os.environ.get("CRIMP_TPU_POLY_TRIG", "").strip().lower() in (
-        "1", "on", "true", "always",
-    )
+    env = os.environ.get("CRIMP_TPU_POLY_TRIG", "").strip().lower()
+    if env in ("1", "on", "true", "always"):
+        return True
+    if env in ("0", "off", "false", "never"):
+        return False
+    import jax
+
+    return jax.default_backend() == "tpu"
 
 
 def sincos_cycles(frac):

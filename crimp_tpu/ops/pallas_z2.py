@@ -41,11 +41,17 @@ TILE_CHUNK = 32  # trial tiles whose f64 base rows are materialized at once
 
 def _make_kernel(nharm: int, trial_tile: int):
     def kernel(base_ref, b_ref, w_ref, c_ref, s_ref):
+        # Inputs are (rows, 1, events) with (1, 1, event_chunk) blocks: the
+        # TPU lowering constrains only the LAST TWO block dims (sublane %
+        # 8 / lane % 128, or equal to the array dim) — the singleton middle
+        # dim satisfies "equal", and row selection rides the untiled
+        # leading dim, so no dynamic in-kernel indexing is needed.
         e = pl.program_id(1)
-        cb = base_ref[0, :]  # (EV,) f32, mod-1 reduced
-        b = b_ref[0, :]
-        w = w_ref[0, :]
-        j_lo = jax.lax.broadcasted_iota(jnp.float32, (trial_tile, 1), 0)
+        cb = base_ref[0, 0, :]  # (EV,) f32, mod-1 reduced
+        b = b_ref[0, 0, :]
+        w = w_ref[0, 0, :]
+        # Mosaic's iota is integer-only; cast after
+        j_lo = jax.lax.broadcasted_iota(jnp.int32, (trial_tile, 1), 0).astype(jnp.float32)
         phase = cb[None, :] + j_lo * b[None, :]  # (T, EV)
         frac = phase - jnp.round(phase)
         sin1, cos1 = fasttrig.sincos_cycles(frac)
@@ -80,9 +86,9 @@ def _tile_chunk_sums(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, event_chunk), lambda i, e: (i, e)),
-            pl.BlockSpec((1, event_chunk), lambda i, e: (0, e)),
-            pl.BlockSpec((1, event_chunk), lambda i, e: (0, e)),
+            pl.BlockSpec((1, 1, event_chunk), lambda i, e: (i, 0, e)),
+            pl.BlockSpec((1, 1, event_chunk), lambda i, e: (0, 0, e)),
+            pl.BlockSpec((1, 1, event_chunk), lambda i, e: (0, 0, e)),
         ],
         out_specs=(
             pl.BlockSpec((1, nharm, trial_tile), lambda i, e: (i, 0, 0)),
@@ -90,7 +96,7 @@ def _tile_chunk_sums(
         ),
         out_shape=(out_shape, out_shape),
         interpret=interpret,
-    )(base, b, w)
+    )(base[:, None, :], b[:, None, :], w[:, None, :])
     return c, s
 
 

@@ -63,6 +63,7 @@ class ToAFitConfig(NamedTuple):
     kind: str = FOURIER
     ph_shift_res: int = 1000  # error-scan resolution: step = 2*pi/res
     n_brute: int = 128  # coarse global grid over the phShift range
+    brute_chunk: int = 64  # brute phases evaluated per launch (HBM bound)
     newton_iters: int = 30  # inner norm solve
     refine_iters: int = 50  # golden-section refine of the grid optimum
     err_chunk: int = 32  # error-scan steps evaluated per while_loop pass
@@ -483,9 +484,22 @@ def fit_segment(kind: str, tpl: ProfileParams, x: jax.Array, mask: jax.Array, ex
     half_range = _phase_range(kind)
 
     # 1) coarse global brute grid (the reference's brutemin path is the
-    #    default here: the grid is effectively free once vectorized)
+    #    default here: the grid is effectively free once vectorized).
+    #    Chunked with lax.map so the vmapped (segments, phases, events)
+    #    tensor never exceeds HBM: a 500-segment config-4 batch at
+    #    n_brute=128 is ~8 GB per temp unchunked (OOMed a 16 GB chip).
     brute_phis = jnp.linspace(-half_range, half_range, cfg.n_brute)
-    ll_brute, _ = profile_loglik(kind, tpl, x, mask, exposure, brute_phis, cfg)
+    chunk = max(1, min(cfg.brute_chunk, cfg.n_brute))
+    pad = (-cfg.n_brute) % chunk
+    phis_pad = (
+        jnp.concatenate([brute_phis, jnp.full((pad,), brute_phis[-1])])
+        if pad
+        else brute_phis
+    )
+    ll_brute = jax.lax.map(
+        lambda p: profile_loglik(kind, tpl, x, mask, exposure, p, cfg)[0],
+        phis_pad.reshape(-1, chunk),
+    ).reshape(-1)[: cfg.n_brute]
     i_best = jnp.argmax(ll_brute)
     phi0 = brute_phis[i_best]
     grid_step = 2 * half_range / (cfg.n_brute - 1)

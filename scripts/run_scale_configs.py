@@ -24,10 +24,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import pathlib
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
 F0 = 0.1432  # injected spin frequency (1E 2259+586-like), Hz
 FDOT = -1e-14  # injected spin-down, Hz/s

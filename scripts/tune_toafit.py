@@ -94,8 +94,10 @@ def main():
         "refine_iters": [15, 25, 50],
         "err_chunk": [16, 32, 64, 128],
         "n_brute": [48, 96, 128, 256],
+        "brute_chunk": [32, 64, 128],
     }
-    defaults = dict(newton_iters=30, refine_iters=50, err_chunk=32, n_brute=128)
+    defaults = dict(newton_iters=30, refine_iters=50, err_chunk=32, n_brute=128,
+                    brute_chunk=64)
 
     results = []
     # axis-by-axis sweep around the current defaults (full product would be

@@ -210,14 +210,22 @@ class TestPolyTrig:
         assert np.max(np.abs(np.asarray(c) - np.cos(2 * np.pi * x))) < 4.0e-8
 
     def test_env_and_override_resolution(self, monkeypatch):
+        import jax
+
         from crimp_tpu.ops import fasttrig
 
+        # unset env -> backend auto-default (on for TPU, off elsewhere);
+        # this suite forces CPU but the assertion must hold on any host
         monkeypatch.delenv("CRIMP_TPU_POLY_TRIG", raising=False)
-        assert not fasttrig.poly_trig_enabled()
+        assert fasttrig.poly_trig_enabled() == (jax.default_backend() == "tpu")
         assert fasttrig.poly_trig_enabled(True)
         monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "1")
         assert fasttrig.poly_trig_enabled()
         assert not fasttrig.poly_trig_enabled(False)
+        # explicit env off beats the backend auto-default
+        monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "0")
+        assert not fasttrig.poly_trig_enabled()
+        assert fasttrig.poly_trig_enabled(True)
 
     def test_z2_poly_matches_hardware_trig(self, sim_events, monkeypatch):
         """Statistic parity: the poly-trig scan must agree with the hardware
