@@ -187,26 +187,33 @@ class TestOnChipToABatch:
             hw = lambda: search.z2_power_grid(sec, f0, df, n_trials, 2)
             poly = lambda: search.z2_power_grid(sec, f0, df, n_trials, 2, poly=True)
             pallas = lambda: z2_power_grid_pallas(sec, f0, df, n_trials, 2)
-            r_hw, r_poly, r_pallas = rate(hw), rate(poly), rate(pallas)
-            a, b, c = (np.asarray(f()) for f in (hw, poly, pallas))
+            # measure each path independently: one path failing to compile
+            # must not lose the others' numbers (round-3 lesson)
+            out = {}
+            a = np.asarray(hw())
             denom = np.maximum(a, 1.0)
-            print(json.dumps({
-                "trials_per_sec_hw": r_hw,
-                "trials_per_sec_poly": r_poly,
-                "trials_per_sec_pallas": r_pallas,
-                "poly_max_rel_dev": float(np.max(np.abs(b - a) / denom)),
-                "pallas_max_rel_dev": float(np.max(np.abs(c - a) / denom)),
-            }))
+            out["trials_per_sec_hw"] = rate(hw)
+            for key, fn in (("poly", poly), ("pallas", pallas)):
+                try:
+                    out[f"trials_per_sec_{key}"] = rate(fn)
+                    out[f"{key}_max_rel_dev"] = float(
+                        np.max(np.abs(np.asarray(fn()) - a) / denom))
+                except Exception as exc:
+                    out[f"trials_per_sec_{key}"] = None
+                    out[f"{key}_error"] = f"{type(exc).__name__}: {str(exc)[:300]}"
+            print(json.dumps(out))
             """,
             timeout=1800.0,
         )
-        assert result["poly_max_rel_dev"] < 5e-3
-        assert result["pallas_max_rel_dev"] < 2e-2
         print(
             f"Z2 trials/s — hw: {result['trials_per_sec_hw']:.0f}, "
-            f"poly: {result['trials_per_sec_poly']:.0f}, "
-            f"pallas: {result['trials_per_sec_pallas']:.0f}"
+            f"poly: {result['trials_per_sec_poly']}, "
+            f"pallas: {result['trials_per_sec_pallas']}"
         )
+        assert result.get("pallas_error") is None, result["pallas_error"]
+        assert result.get("poly_error") is None, result["poly_error"]
+        assert result["poly_max_rel_dev"] < 5e-3
+        assert result["pallas_max_rel_dev"] < 2e-2
 
     def test_fastpath_vs_f64_bound_1e5_trials(self):
         """On-chip fast-path Z^2 must stay within the documented deviation
