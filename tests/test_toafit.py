@@ -141,6 +141,24 @@ class TestPaddingInvariance:
         assert np.isclose(float(out["phShift"][0]), res_plain["phShift"], atol=1e-10)
         assert np.isclose(float(out["logLmax"][0]), res_plain["logLmax"], atol=1e-6)
 
+    def test_brute_chunking_does_not_change_fit(self):
+        """The HBM-bounding chunked brute grid (lax.map over brute_chunk
+        phases) must be bit-identical to the single-launch evaluation for
+        every chunking, including sizes that do not divide n_brute."""
+        rng = np.random.RandomState(31)
+        kind = profiles.FOURIER
+        tpl = template(kind)
+        phases = draw_phases(kind, tpl, 2000, rng, ph_shift=-0.4)
+        exposure = 2000 / 17.0
+        ref = fit_one(kind, tpl, phases, exposure, n_brute=128, brute_chunk=128)
+        for chunk in (1, 17, 32, 64, 500):
+            got = fit_one(kind, tpl, phases, exposure, n_brute=128,
+                          brute_chunk=chunk)
+            assert got["phShift"] == ref["phShift"], chunk
+            assert got["phShift_LL"] == ref["phShift_LL"], chunk
+            assert got["phShift_UL"] == ref["phShift_UL"], chunk
+            assert got["logLmax"] == ref["logLmax"], chunk
+
     def test_batch_matches_individual(self):
         rng = np.random.RandomState(9)
         kind = profiles.FOURIER
