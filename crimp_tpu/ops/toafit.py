@@ -64,8 +64,17 @@ class ToAFitConfig(NamedTuple):
     ph_shift_res: int = 1000  # error-scan resolution: step = 2*pi/res
     n_brute: int = 128  # coarse global grid over the phShift range
     brute_chunk: int = 64  # brute phases evaluated per launch (HBM bound)
-    newton_iters: int = 30  # inner norm solve
-    refine_iters: int = 50  # golden-section refine of the grid optimum
+    # Iteration defaults from the measured accuracy frontier
+    # (scripts/tune_toafit.py; docs/performance.md "ToA-engine tuning"):
+    # newton=20 is 2x the smallest swept value that bit-matched a
+    # (60, 80)-iteration reference; refine=25 is the smallest bit-matching
+    # value, with margin in the consequence space — the next value down
+    # (15) drifts phShift only 1.2e-5 rad, three orders below the ~3e-2
+    # rad error bars, and golden-section precision improves geometrically
+    # (x0.618) per iteration. The shipped combination is also measured
+    # jointly by the sweep script's "shipped_defaults" row.
+    newton_iters: int = 20  # inner norm solve (concave, quadratic conv.)
+    refine_iters: int = 25  # golden-section refine of the grid optimum
     err_chunk: int = 32  # error-scan steps evaluated per while_loop pass
     nbins: int = 15  # binned-profile chi2 reporting
     norm_lo_frac: float = 0.01  # norm lower bound = frac * template norm

@@ -96,8 +96,17 @@ def main():
         "n_brute": [48, 96, 128, 256],
         "brute_chunk": [32, 64, 128],
     }
-    defaults = dict(newton_iters=30, refine_iters=50, err_chunk=32, n_brute=128,
-                    brute_chunk=64)
+    # pivot around the SHIPPED defaults so each row corresponds to a
+    # configuration a default-config user actually runs
+    _d = toafit.ToAFitConfig()
+    defaults = {axis: getattr(_d, axis) for axis in sweep}
+
+    # joint sanity rows: the shipped default combination (and its
+    # vary_amps variant) measured as-is against the reference — the
+    # axis-by-axis rows never exercise the combination itself
+    wall_def, out_def = timed(toafit.ToAFitConfig(kind=kind, ph_shift_res=args.res))
+    d_phi_def = float(np.max(np.abs(out_def["phShift"] - ref["phShift"])))
+    log(f"[tune] shipped defaults: {wall_def:.2f}s, d_phi={d_phi_def:.2e}")
 
     results = []
     # axis-by-axis sweep around the current defaults (full product would be
@@ -122,7 +131,12 @@ def main():
             log(f"[tune] {axis}={v}: {row['wall_s']}s, d_phi={row['d_phi_rad']}, "
                 f"d_err={row['d_err_steps']} steps")
 
-    print(json.dumps({"reference_wall_s": round(ref_wall, 3), "rows": results}))
+    print(json.dumps({
+        "reference_wall_s": round(ref_wall, 3),
+        "shipped_defaults": {**defaults, "wall_s": round(wall_def, 3),
+                             "d_phi_rad": d_phi_def},
+        "rows": results,
+    }))
 
 
 if __name__ == "__main__":
