@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from crimp_tpu.ops import fasttrig
 from crimp_tpu.ops.search import chebyshev_weighted_sums
@@ -95,6 +96,11 @@ def _tile_chunk_sums(
             pl.BlockSpec((1, nharm, trial_tile), lambda i, e: (i, 0, 0)),
         ),
         out_shape=(out_shape, out_shape),
+        # trial tiles are independent (parallel); the event axis revisits
+        # the same output block (sequential accumulation -> arbitrary)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
         interpret=interpret,
     )(base[:, None, :], b[:, None, :], w[:, None, :])
     return c, s

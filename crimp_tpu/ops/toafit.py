@@ -65,14 +65,15 @@ class ToAFitConfig(NamedTuple):
     n_brute: int = 128  # coarse global grid over the phShift range
     brute_chunk: int = 64  # brute phases evaluated per launch (HBM bound)
     # Iteration defaults from the measured accuracy frontier
-    # (scripts/tune_toafit.py; docs/performance.md "ToA-engine tuning"):
-    # newton=20 is 2x the smallest swept value that bit-matched a
-    # (60, 80)-iteration reference; refine=25 is the smallest bit-matching
-    # value, with margin in the consequence space — the next value down
-    # (15) drifts phShift only 1.2e-5 rad, three orders below the ~3e-2
-    # rad error bars, and golden-section precision improves geometrically
-    # (x0.618) per iteration. The shipped combination is also measured
-    # jointly by the sweep script's "shipped_defaults" row.
+    # (scripts/tune_toafit.py; evidence docs/tuning_cpu_r3.json): vs a
+    # (n_brute=512, newton=60, refine=80) reference, newton=10..45 all sit
+    # at the same ~1.8e-7 rad d_phi floor (that residual is golden-section
+    # precision, not Newton error) with ZERO error-bound step flips, so
+    # newton=20 is 2x the smallest swept value; refine=25 reaches the same
+    # floor (refine=15 drifts 1.2e-5 rad — still three orders below the
+    # ~3e-2 rad error bars). The shipped combination is measured jointly
+    # (d_phi 1.8e-7, d_err 0), as is its vary_amps variant (the 2-D
+    # solver runs 2*newton_iters; d_phi 1.5e-7, d_err 0).
     newton_iters: int = 20  # inner norm solve (concave, quadratic conv.)
     refine_iters: int = 25  # golden-section refine of the grid optimum
     err_chunk: int = 32  # error-scan steps evaluated per while_loop pass

@@ -101,12 +101,37 @@ def main():
     _d = toafit.ToAFitConfig()
     defaults = {axis: getattr(_d, axis) for axis in sweep}
 
-    # joint sanity rows: the shipped default combination (and its
-    # vary_amps variant) measured as-is against the reference — the
-    # axis-by-axis rows never exercise the combination itself
+    def accuracy(out, ref_out):
+        """(d_phi, d_err_steps) vs a reference fit — FULL precision, no
+        rounding: quantized-bound flips are exact multiples of the step,
+        and d_phi values below 1e-6 rad matter for the frontier record."""
+        d_phi = float(np.max(np.abs(out["phShift"] - ref_out["phShift"])))
+        d_err = float(
+            max(
+                np.max(np.abs(out["phShift_LL"] - ref_out["phShift_LL"])),
+                np.max(np.abs(out["phShift_UL"] - ref_out["phShift_UL"])),
+            ) / step
+        )
+        return d_phi, d_err
+
+    # joint sanity row: the shipped default combination measured as-is —
+    # the axis-by-axis rows never exercise the combination itself
     wall_def, out_def = timed(toafit.ToAFitConfig(kind=kind, ph_shift_res=args.res))
-    d_phi_def = float(np.max(np.abs(out_def["phShift"] - ref["phShift"])))
-    log(f"[tune] shipped defaults: {wall_def:.2f}s, d_phi={d_phi_def:.2e}")
+    d_phi_def, d_err_def = accuracy(out_def, ref)
+    log(f"[tune] shipped defaults: {wall_def:.2f}s, d_phi={d_phi_def:.2e}, "
+        f"d_err={d_err_def} steps")
+
+    # vary_amps joint row: the 2-D (norm, ampShift) solver runs
+    # 2*newton_iters and is NOT covered by the fixed-shape sweep; measure
+    # the shipped defaults against a high-effort vary_amps reference
+    log("[tune] running vary_amps reference + shipped defaults ...")
+    _, ref_va = timed(ref_cfg._replace(vary_amps=True))
+    wall_va, out_va = timed(
+        toafit.ToAFitConfig(kind=kind, ph_shift_res=args.res, vary_amps=True)
+    )
+    d_phi_va, d_err_va = accuracy(out_va, ref_va)
+    log(f"[tune] vary_amps defaults: {wall_va:.2f}s, d_phi={d_phi_va:.2e}, "
+        f"d_err={d_err_va} steps")
 
     results = []
     # axis-by-axis sweep around the current defaults (full product would be
@@ -117,24 +142,22 @@ def main():
             kw[axis] = v
             cfg = toafit.ToAFitConfig(kind=kind, ph_shift_res=args.res, **kw)
             wall, out = timed(cfg)
-            d_phi = float(np.max(np.abs(out["phShift"] - ref["phShift"])))
-            d_err = float(
-                max(
-                    np.max(np.abs(out["phShift_LL"] - ref["phShift_LL"])),
-                    np.max(np.abs(out["phShift_UL"] - ref["phShift_UL"])),
-                ) / step
-            )
+            d_phi, d_err = accuracy(out, ref)
             row = {"axis": axis, "value": v, "wall_s": round(wall, 3),
                    "toas_per_sec": round(args.segments / wall, 1),
-                   "d_phi_rad": round(d_phi, 6), "d_err_steps": round(d_err, 2)}
+                   "d_phi_rad": d_phi, "d_err_steps": d_err}
             results.append(row)
-            log(f"[tune] {axis}={v}: {row['wall_s']}s, d_phi={row['d_phi_rad']}, "
-                f"d_err={row['d_err_steps']} steps")
+            log(f"[tune] {axis}={v}: {row['wall_s']}s, d_phi={d_phi:.2e}, "
+                f"d_err={d_err} steps")
 
     print(json.dumps({
         "reference_wall_s": round(ref_wall, 3),
         "shipped_defaults": {**defaults, "wall_s": round(wall_def, 3),
-                             "d_phi_rad": d_phi_def},
+                             "d_phi_rad": d_phi_def, "d_err_steps": d_err_def},
+        "shipped_defaults_vary_amps": {
+            "wall_s": round(wall_va, 3),
+            "d_phi_rad": d_phi_va, "d_err_steps": d_err_va,
+        },
         "rows": results,
     }))
 
