@@ -125,7 +125,7 @@ def main():
     # 2*newton_iters and is NOT covered by the fixed-shape sweep; measure
     # the shipped defaults against a high-effort vary_amps reference
     log("[tune] running vary_amps reference + shipped defaults ...")
-    _, ref_va = timed(ref_cfg._replace(vary_amps=True))
+    ref_va = run(ref_cfg._replace(vary_amps=True))  # wall-clock unused
     wall_va, out_va = timed(
         toafit.ToAFitConfig(kind=kind, ph_shift_res=args.res, vary_amps=True)
     )

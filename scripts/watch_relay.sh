@@ -1,0 +1,26 @@
+#!/bin/bash
+# Watch the accelerator relay and launch the on-chip session the moment it
+# recovers. Probes every PERIOD seconds (default 600) with a 290 s budget;
+# a down relay HANGS the probe, so the timeout is the detector. Exits
+# after the session completes (or after MAX_HOURS of watching).
+#
+# Usage: bash scripts/watch_relay.sh [outdir] [period_s] [max_hours]
+
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-onchip_results}"
+PERIOD="${2:-600}"
+MAX_HOURS="${3:-8}"
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+
+echo "[watch] watching relay (period ${PERIOD}s, until $(date -u -d @${DEADLINE} +%H:%M 2>/dev/null || echo +${MAX_HOURS}h))"
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    if timeout 290 python -c "import jax; jax.devices()" > /dev/null 2>&1; then
+        echo "[watch] relay healthy at $(date -u +%H:%M:%S) — launching session"
+        bash scripts/onchip_session.sh "$OUT"
+        exit $?
+    fi
+    sleep "$PERIOD"
+done
+echo "[watch] gave up at $(date -u +%H:%M:%S)"
+exit 1
