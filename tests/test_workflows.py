@@ -361,6 +361,41 @@ class TestFullJourney:
         assert np.all(np.abs(resid) < 6 * table["F0_err"].to_numpy() + 5e-8)
 
 
+class TestDriverEntryContract:
+    """entry() must return (fn, example_args) without touching any JAX
+    backend — on a host whose default backend is a wedged accelerator
+    relay, backend init HANGS, and a hung entry() zeroes the round's
+    compile-check artifact (rounds 1-2 history). This module has no
+    device-count gate, so the pin runs on every host."""
+
+    def test_entry_never_initializes_a_backend(self):
+        import os
+        import pathlib
+        import subprocess
+        import sys
+
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        repo_root = pathlib.Path(__file__).parent.parent
+        env["PYTHONPATH"] = str(repo_root) + os.pathsep + env.get("PYTHONPATH", "")
+        out = subprocess.run(
+            [sys.executable, "-c", (
+                "import __graft_entry__ as g\n"
+                "fn, args = g.entry()\n"
+                "from jax._src import xla_bridge\n"
+                "assert not xla_bridge._backends, xla_bridge._backends\n"
+                "import numpy as np\n"
+                "assert all(isinstance(x, np.ndarray) or np.isscalar(x)\n"
+                "           for x in args[1:])\n"
+                "print('ENTRY-CLEAN')\n"
+            )],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-1500:]
+        assert "ENTRY-CLEAN" in out.stdout
+
+
 class TestLogging:
     def test_configure_logging_writes_truncated_file(self, tmp_path):
         import logging
