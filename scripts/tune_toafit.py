@@ -133,6 +133,16 @@ def main():
     log(f"[tune] vary_amps defaults: {wall_va:.2f}s, d_phi={d_phi_va:.2e}, "
         f"d_err={d_err_va} steps")
 
+    # grid-refine A/B: same shipped defaults, serial-depth-4 vectorized
+    # refine instead of the golden-section chain (the on-chip wall-clock
+    # decides whether to promote it; accuracy must stay on the floor)
+    wall_grid, out_grid = timed(
+        toafit.ToAFitConfig(kind=kind, ph_shift_res=args.res, refine_mode="grid")
+    )
+    d_phi_grid, d_err_grid = accuracy(out_grid, ref)
+    log(f"[tune] grid-refine defaults: {wall_grid:.2f}s, d_phi={d_phi_grid:.2e}, "
+        f"d_err={d_err_grid} steps")
+
     results = []
     # axis-by-axis sweep around the current defaults (full product would be
     # 192 compiles); each axis varies alone
@@ -157,6 +167,10 @@ def main():
         "shipped_defaults_vary_amps": {
             "wall_s": round(wall_va, 3),
             "d_phi_rad": d_phi_va, "d_err_steps": d_err_va,
+        },
+        "grid_refine": {
+            "wall_s": round(wall_grid, 3),
+            "d_phi_rad": d_phi_grid, "d_err_steps": d_err_grid,
         },
         "rows": results,
     }))

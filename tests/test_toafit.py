@@ -177,6 +177,37 @@ class TestPaddingInvariance:
             assert np.isclose(float(batch["phShift"][i]), solo["phShift"], atol=1e-9)
 
 
+class TestRefineModes:
+    def test_grid_refine_matches_golden(self):
+        """The vectorized nested-grid refine (serial depth refine_rounds)
+        must land on the same optimum as golden-section to well below the
+        error bars, with identical quantized error bounds."""
+        rng = np.random.RandomState(17)
+        kind = profiles.FOURIER
+        tpl = template(kind)
+        for shift in (-0.45, 0.2):
+            phases = draw_phases(kind, tpl, 3000, rng, ph_shift=shift)
+            exposure = 3000 / 17.0
+            golden = fit_one(kind, tpl, phases, exposure, refine_mode="golden")
+            grid = fit_one(kind, tpl, phases, exposure, refine_mode="grid")
+            # both modes sit at their documented precision floors (~1e-6)
+            assert abs(grid["phShift"] - golden["phShift"]) < 1e-5
+            assert grid["phShift_LL"] == golden["phShift_LL"]
+            assert grid["phShift_UL"] == golden["phShift_UL"]
+            assert abs(grid["logLmax"] - golden["logLmax"]) < 1e-6
+
+    def test_bad_mode_and_grid_validation(self):
+        rng = np.random.RandomState(18)
+        kind = profiles.FOURIER
+        tpl = template(kind)
+        phases = draw_phases(kind, tpl, 500, rng)
+        with pytest.raises(ValueError, match="refine_mode"):
+            fit_one(kind, tpl, phases, 500 / 17.0, refine_mode="Grid")
+        with pytest.raises(ValueError, match="refine_grid"):
+            fit_one(kind, tpl, phases, 500 / 17.0, refine_mode="grid",
+                    refine_grid=32)
+
+
 class TestVaryAmps:
     def test_recovers_amp_scaling(self):
         """varyAmps frees ampShift (second-stage refit, measureToAs.py:306-312):
