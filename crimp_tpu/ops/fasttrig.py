@@ -52,6 +52,16 @@ def poly_trig_enabled(override: bool | None = None) -> bool:
 
     Precedence: explicit ``override`` > ``CRIMP_TPU_POLY_TRIG`` env var >
     backend auto-default (on for TPU, off for CPU/GPU).
+
+    A value outside the recognized on/off sets raises: silently treating a
+    typo ('of', 'yes') as unset would auto-ENABLE poly trig on TPU, the
+    opposite of what the user plausibly meant.
+
+    The auto-default branch calls ``jax.default_backend()``, which
+    INITIALIZES the JAX backend (a multi-second handshake through the
+    accelerator relay, and a hang if the relay is wedged). It must only be
+    reached from the compute path, never from entry-time/config-printing
+    code — the driver-entry contract (``__graft_entry__.entry``) pins this.
     """
     if override is not None:
         return bool(override)
@@ -60,6 +70,13 @@ def poly_trig_enabled(override: bool | None = None) -> bool:
         return True
     if env in ("0", "off", "false", "never"):
         return False
+    if env == "auto":  # the documented default, spelled explicitly
+        env = ""
+    if env:
+        raise ValueError(
+            f"CRIMP_TPU_POLY_TRIG={os.environ['CRIMP_TPU_POLY_TRIG']!r} not recognized; "
+            "use 1/on/true/always, 0/off/false/never, or auto/unset for the backend default"
+        )
     import jax
 
     return jax.default_backend() == "tpu"

@@ -1,0 +1,43 @@
+"""The shared on-chip A/B measurement workload.
+
+The Z^2 trig-path A/B (tests/test_tpu_tier.py), the block-size sweep
+(scripts/sweep_blocks.py), and the recorded perf-guard rates
+(docs/onchip_rates.json via scripts/extract_rates.py) must all measure the
+SAME workload, or sweep winners and guard thresholds silently stop being
+comparable. This module is that single definition: bench scale (8e5
+events x 1e5 trials on a uniform grid around the 1E 2259+586 spin
+frequency), best-of-N timing after one warmup.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+AB_N_EVENTS = 800_000
+AB_N_TRIALS = 100_000
+AB_SEED = 7
+
+
+def ab_workload(n_events: int = AB_N_EVENTS, n_trials: int = AB_N_TRIALS,
+                seed: int = AB_SEED):
+    """(sec, freqs, f0, df): the canonical A/B scan problem."""
+    from crimp_tpu.ops import search
+
+    rng = np.random.RandomState(seed)
+    sec = np.sort(rng.uniform(-4e5, 4e5, n_events))
+    freqs = np.linspace(0.1430, 0.1436, n_trials)
+    f0, df = search.uniform_grid(freqs)
+    return sec, freqs, f0, df
+
+
+def best_rate(fn, n_trials: int, repeats: int = 3) -> float:
+    """trials/s from the best of ``repeats`` timed runs after one warmup."""
+    fn().block_until_ready()
+    best = np.inf
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn().block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return n_trials / best

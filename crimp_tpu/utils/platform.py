@@ -1,0 +1,29 @@
+"""Platform forcing for scripts and dry-runs.
+
+The deployment's site hook overrides the ``JAX_PLATFORMS`` environment
+variable, so env alone CANNOT keep a process off the accelerator relay —
+the only reliable mechanism is ``jax.config.update("jax_platforms", "cpu")``
+after import and before the first array op (the same one
+tests/conftest.py and __graft_entry__.dryrun_multichip use). This module
+keeps that workaround in one place for every script that needs a
+``--cpu`` dry-run mode.
+"""
+
+from __future__ import annotations
+
+
+def add_cpu_flag(parser) -> None:
+    """Add the standard ``--cpu`` dry-run flag to an argparse parser."""
+    parser.add_argument(
+        "--cpu",
+        action="store_true",
+        help="force the CPU platform (the site hook overrides the "
+             "JAX_PLATFORMS env var; only jax.config wins)",
+    )
+
+
+def force_cpu_platform() -> None:
+    """Pin this process to the CPU backend (call before any array op)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

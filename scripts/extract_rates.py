@@ -1,0 +1,88 @@
+"""Turn a completed on-chip session's logs into docs/onchip_rates.json.
+
+The TPU test tier guards against perf regressions by asserting measured
+rates stay above GUARD_FRAC x the officially recorded ones
+(tests/test_tpu_tier.py::assert_rate); this writes that record from the
+session artifacts. Only a session whose bench ran on the accelerator
+qualifies — a CPU-fallback bench must never become the guard.
+
+Usage: python scripts/extract_rates.py <session_outdir>
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+import sys
+
+
+def main() -> int:
+    out = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "onchip_results")
+    repo = pathlib.Path(__file__).resolve().parents[1]
+
+    bench_log = out / "bench.log"
+    if not bench_log.exists():
+        print(f"no {bench_log}; nothing to extract", file=sys.stderr)
+        return 1
+    bench = None
+    for line in bench_log.read_text().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                bench = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    if not bench:
+        print("no JSON line in bench.log", file=sys.stderr)
+        return 1
+    if bench.get("platform") != "tpu":
+        print(f"bench platform is {bench.get('platform')!r}, not tpu; refusing "
+              "to record CPU-fallback rates as the on-chip guard", file=sys.stderr)
+        return 1
+
+    rates = {
+        "platform": bench["platform"],
+        # bench "value" times the FULL per-ToA pipeline (segment prep +
+        # anchored fold + batch fit + H-test); the tier's guard key
+        # "toas_per_sec" must instead come from the tier's own batch-fit-only
+        # timing below — guarding the tier's number with the (much lower)
+        # pipeline rate would loosen the 0.5x guard ~10x.
+        "toas_per_sec_pipeline": bench.get("value"),
+        "z2_trials_per_sec_poly": bench.get("z2_trials_per_sec_poly"),
+    }
+    if bench.get("z2_trials_per_sec_pallas"):
+        rates["z2_trials_per_sec_pallas"] = bench["z2_trials_per_sec_pallas"]
+
+    tier_log = out / "tpu_tier.log"
+    if tier_log.exists():
+        text = tier_log.read_text()
+        m = re.search(r"C_trig \(FMA-op equivalents per sin/cos\): ([\d.]+)", text)
+        if m:
+            rates["c_trig_ops_equiv"] = float(m.group(1))
+        m = re.search(r"tier toas_per_sec: ([\d.]+)", text)
+        if m:
+            rates["toas_per_sec"] = float(m.group(1))
+
+    rates = {k: v for k, v in rates.items() if v is not None}
+    dest = repo / "docs" / "onchip_rates.json"
+    # Ratchet, don't overwrite: keep the BEST recorded value per key so a
+    # within-guard (sub-2x) regression can never lower the baseline and
+    # compound silently across sessions. "Best" is key-specific: rates go
+    # up, C_trig (op-cost) goes down.
+    if dest.exists():
+        old = json.loads(dest.read_text())
+        for key, val in old.items():
+            if not isinstance(val, (int, float)) or key not in rates:
+                rates.setdefault(key, val)
+            elif key == "c_trig_ops_equiv":
+                rates[key] = min(rates[key], val)
+            else:
+                rates[key] = max(rates[key], val)
+    dest.write_text(json.dumps(rates, indent=2) + "\n")
+    print(f"wrote {dest}: {rates}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -158,10 +158,15 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--config", default="all", choices=["3", "5", "all"])
+    from crimp_tpu.utils.platform import add_cpu_flag, force_cpu_platform
+
+    add_cpu_flag(ap)
     args = ap.parse_args()
 
     import jax
 
+    if args.cpu:
+        force_cpu_platform()
     log(f"[scale_configs] devices: {jax.devices()}")
     if args.config in ("3", "all"):
         print(json.dumps(config3(args.scale)), flush=True)

@@ -1,0 +1,107 @@
+"""On-chip block-size sweep for the uniform-grid Z^2 fast path.
+
+The roofline (docs/performance.md "Z^2 roofline") puts the poly-trig path
+at ~34% of VPU peak and attributes the gap to scheduling, not math; the
+current GRID_EVENT_BLOCK/GRID_TRIAL_BLOCK (2^15 / 512) were tuned BEFORE
+poly trig landed, so the optimum may have moved (VERDICT r3 item 6). This
+sweeps both knobs at bench scale (8e5 events x 1e5 trials, nharm 2, poly
+trig) plus the Pallas kernel's tile knobs, and prints one JSON line per
+point — paste the winner into ops/search.py / docs/performance.md.
+
+Usage: python scripts/sweep_blocks.py [--events 800000] [--trials 100000]
+       [--pallas]  (also sweep the Pallas kernel's trial_tile/event_chunk)
+Run on the accelerator; CPU ratios do not transfer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--events", type=int, default=800_000)
+    ap.add_argument("--trials", type=int, default=100_000)
+    ap.add_argument("--pallas", action="store_true")
+
+    from crimp_tpu.utils.platform import add_cpu_flag, force_cpu_platform
+
+    add_cpu_flag(ap)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        force_cpu_platform()
+
+    from crimp_tpu.ops import search
+    from crimp_tpu.utils.benchwork import ab_workload, best_rate
+
+    log(f"[sweep_blocks] devices: {jax.devices()}")
+    sec, freqs, f0, df = ab_workload(args.events, args.trials)
+
+    results = []
+    for eb_log2 in (13, 14, 15, 16, 17):
+        for tb in (128, 256, 512, 1024, 2048):
+            eb = 1 << eb_log2
+            try:
+                rate = best_rate(
+                    lambda: search.z2_power_grid(
+                        sec, f0, df, args.trials, 2,
+                        event_block=eb, trial_block=tb, poly=True,
+                    ),
+                    args.trials,
+                )
+            except Exception as exc:  # OOM at big tiles must not end the sweep
+                row = {"event_block": eb, "trial_block": tb,
+                       "error": f"{type(exc).__name__}: {str(exc)[:200]}"}
+                print(json.dumps(row), flush=True)
+                continue
+            row = {"event_block": eb, "trial_block": tb,
+                   "trials_per_sec": round(rate, 1)}
+            results.append(row)
+            print(json.dumps(row), flush=True)
+
+    if results:
+        best = max(results, key=lambda r: r["trials_per_sec"])
+        print(json.dumps({"best": best}), flush=True)
+
+    if args.pallas:
+        from crimp_tpu.ops.pallas_z2 import z2_power_grid_pallas
+
+        pl_results = []
+        for tt in (128, 256, 512):
+            for ec in (1024, 2048, 4096):
+                try:
+                    rate = best_rate(
+                        lambda: z2_power_grid_pallas(
+                            sec, f0, df, args.trials, 2,
+                            trial_tile=tt, event_chunk=ec,
+                        ),
+                        args.trials,
+                    )
+                except Exception as exc:
+                    row = {"pallas_trial_tile": tt, "pallas_event_chunk": ec,
+                           "error": f"{type(exc).__name__}: {str(exc)[:200]}"}
+                    print(json.dumps(row), flush=True)
+                    continue
+                row = {"pallas_trial_tile": tt, "pallas_event_chunk": ec,
+                       "trials_per_sec": round(rate, 1)}
+                pl_results.append(row)
+                print(json.dumps(row), flush=True)
+        if pl_results:
+            best = max(pl_results, key=lambda r: r["trials_per_sec"])
+            print(json.dumps({"pallas_best": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

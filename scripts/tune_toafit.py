@@ -37,11 +37,18 @@ def main():
     ap.add_argument("--segments", type=int, default=84)
     ap.add_argument("--res", type=int, default=1000)
     ap.add_argument("--repeat", type=int, default=2)
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    from crimp_tpu.utils.platform import add_cpu_flag, force_cpu_platform
+
+    add_cpu_flag(ap)
     args = ap.parse_args()
 
     import jax.numpy as jnp
 
-    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    if args.cpu:
+        force_cpu_platform()
+
     from crimp_tpu.io import template as template_io
     from crimp_tpu.models import profiles
     from crimp_tpu.ops import toafit
