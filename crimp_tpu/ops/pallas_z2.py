@@ -115,14 +115,45 @@ def z2_power_grid_pallas(
     trial_tile: int = TRIAL_TILE,
     event_chunk: int = EVENT_CHUNK,
     tile_chunk: int = TILE_CHUNK,
+    fdot: float = 0.0,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Z^2_n over the uniform grid f0 + j*df via the Pallas tile kernel.
 
     Drop-in comparable to ops.search.z2_power_grid (same statistic, f32
     accumulation); ``interpret=True`` runs the kernel in the Pallas
-    interpreter for CPU correctness tests.
+    interpreter for CPU correctness tests. A nonzero ``fdot`` (signed
+    Hz/s) rides the per-tile f64 base row exactly as in the XLA fast path
+    (it is frequency-independent), so the kernel itself is untouched.
     """
+    return z2_power_2d_grid_pallas(
+        times, f0, df, n_freq, [fdot], nharm, trial_tile, event_chunk,
+        tile_chunk, interpret=interpret,
+    )[0]
+
+
+def z2_power_2d_grid_pallas(
+    times,
+    f0: float,
+    df: float,
+    n_freq: int,
+    fdots,
+    nharm: int = 2,
+    trial_tile: int = TRIAL_TILE,
+    event_chunk: int = EVENT_CHUNK,
+    tile_chunk: int = TILE_CHUNK,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Z^2_n over the (fdot x uniform-frequency) grid -> (n_fdot, n_freq).
+
+    The Pallas analog of ops.search.z2_power_2d_grid — the BASELINE
+    config-3 shape. ``fdots`` are SIGNED Hz/s (callers on the reference
+    CLI convention pass -10**log10grid). The event array, its padding, the
+    weight/increment rows, and each chunk's frequency product are computed
+    ONCE and shared across the fdot axis — only the (frequency-independent)
+    quadratic term differs per fdot.
+    """
+    fd_arr = np.asarray(fdots, dtype=np.float64).reshape(-1)
     t64 = jnp.asarray(times, dtype=jnp.float64)
     n = int(t64.shape[0])
     n_pad = -(-n // event_chunk) * event_chunk
@@ -130,21 +161,29 @@ def z2_power_grid_pallas(
     w = jnp.pad(jnp.ones(n, jnp.float32), (0, n_pad - n))[None, :]
     b64 = df * t_pad
     b = (b64 - jnp.round(b64)).astype(jnp.float32)[None, :]
+    quads = [(0.5 * fd) * t_pad**2 for fd in fd_arr]  # f64, trial-independent
 
     n_tiles = -(-n_freq // trial_tile)
-    c_parts, s_parts = [], []
+    c_parts = [[] for _ in fd_arr]
+    s_parts = [[] for _ in fd_arr]
     for chunk_start in range(0, n_tiles, tile_chunk):
         k = min(tile_chunk, n_tiles - chunk_start)
         f_tiles = f0 + (chunk_start + np.arange(k)) * (trial_tile * df)
-        base64 = jnp.asarray(f_tiles)[:, None] * t_pad[None, :]
-        base = (base64 - jnp.round(base64)).astype(jnp.float32)
-        c, s = _tile_chunk_sums(
-            base, b, w, nharm, trial_tile, event_chunk, interpret
-        )
-        c_parts.append(c)
-        s_parts.append(s)
-    c_all = jnp.concatenate(c_parts).astype(jnp.float64)  # (n_tiles, nharm, T)
-    s_all = jnp.concatenate(s_parts).astype(jnp.float64)
-    c_flat = jnp.moveaxis(c_all, 1, 0).reshape(nharm, -1)[:, :n_freq]
-    s_flat = jnp.moveaxis(s_all, 1, 0).reshape(nharm, -1)[:, :n_freq]
-    return jnp.sum((c_flat**2 + s_flat**2) * (2.0 / n), axis=0)
+        freq64 = jnp.asarray(f_tiles)[:, None] * t_pad[None, :]
+        for i, quad in enumerate(quads):
+            base64 = freq64 + quad[None, :]
+            base = (base64 - jnp.round(base64)).astype(jnp.float32)
+            c, s = _tile_chunk_sums(
+                base, b, w, nharm, trial_tile, event_chunk, interpret
+            )
+            c_parts[i].append(c)
+            s_parts[i].append(s)
+
+    def flat(parts):
+        all_ = jnp.concatenate(parts).astype(jnp.float64)  # (n_tiles, nharm, T)
+        return jnp.moveaxis(all_, 1, 0).reshape(nharm, -1)[:, :n_freq]
+
+    return jnp.stack([
+        jnp.sum((flat(c_parts[i]) ** 2 + flat(s_parts[i]) ** 2) * (2.0 / n), axis=0)
+        for i in range(len(fd_arr))
+    ])

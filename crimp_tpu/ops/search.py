@@ -50,10 +50,35 @@ from crimp_tpu.ops import fasttrig
 DEFAULT_EVENT_BLOCK = 1 << 16
 DEFAULT_TRIAL_BLOCK = 256
 DEFAULT_TRIG_DTYPE = jnp.float32
+
+
+def _env_blocks(default_event: int, default_trial: int) -> tuple[int, int]:
+    """CRIMP_TPU_GRID_BLOCKS="<event_block>,<trial_block>" override.
+
+    Lets an on-chip sweep winner (scripts/sweep_blocks.py) be applied
+    without a code edit. Read once at import; malformed values raise
+    (silently ignoring a typo'd perf knob would be invisible).
+    """
+    env = os.environ.get("CRIMP_TPU_GRID_BLOCKS", "").strip()
+    if not env:
+        return default_event, default_trial
+    try:
+        eb_s, tb_s = env.split(",")
+        eb, tb = int(eb_s), int(tb_s)
+        if eb <= 0 or tb <= 0:
+            raise ValueError
+    except ValueError:
+        raise ValueError(
+            f"CRIMP_TPU_GRID_BLOCKS={env!r} not recognized; expected two "
+            "positive integers '<event_block>,<trial_block>' (e.g. 32768,512)"
+        ) from None
+    return eb, tb
+
+
 # Grid fast path: measured optimum on TPU v5e (34.6k vs 33.1k trials/s for
-# the general defaults; see docs/performance.md).
-GRID_EVENT_BLOCK = 1 << 15
-GRID_TRIAL_BLOCK = 512
+# the general defaults; see docs/performance.md). Overridable via
+# CRIMP_TPU_GRID_BLOCKS while the post-poly-trig sweep is pending.
+GRID_EVENT_BLOCK, GRID_TRIAL_BLOCK = _env_blocks(1 << 15, 512)
 # The fast path's f32 inner sweep carries phase error up to
 # trial_block/2 * 2^-24 cycles, which the Chebyshev recurrence amplifies
 # ~linearly in harmonic number; past this order the error budget is no

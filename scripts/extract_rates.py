@@ -43,16 +43,19 @@ def main() -> int:
 
     rates = {
         "platform": bench["platform"],
-        # bench "value" times the FULL per-ToA pipeline (segment prep +
-        # anchored fold + batch fit + H-test); the tier's guard key
-        # "toas_per_sec" must instead come from the tier's own batch-fit-only
-        # timing below — guarding the tier's number with the (much lower)
-        # pipeline rate would loosen the 0.5x guard ~10x.
+        # Informational only: bench "value" times the FULL per-ToA pipeline
+        # (segment prep + anchored fold + batch fit + H-test) and bench's
+        # Z^2 numbers come from the gap-structured campaign surrogate. The
+        # GUARD keys (toas_per_sec, z2_trials_per_sec_*) must come from the
+        # tier's own prints below, which measure the one canonical workload
+        # (crimp_tpu/utils/benchwork.py) the tier re-measures at check time
+        # — guarding one workload's rate with another's would mis-set the
+        # 0.5x threshold.
         "toas_per_sec_pipeline": bench.get("value"),
-        "z2_trials_per_sec_poly": bench.get("z2_trials_per_sec_poly"),
+        "z2_trials_per_sec_poly_bench": bench.get("z2_trials_per_sec_poly"),
     }
     if bench.get("z2_trials_per_sec_pallas"):
-        rates["z2_trials_per_sec_pallas"] = bench["z2_trials_per_sec_pallas"]
+        rates["z2_trials_per_sec_pallas_bench"] = bench["z2_trials_per_sec_pallas"]
 
     tier_log = out / "tpu_tier.log"
     if tier_log.exists():
@@ -60,9 +63,11 @@ def main() -> int:
         m = re.search(r"C_trig \(FMA-op equivalents per sin/cos\): ([\d.]+)", text)
         if m:
             rates["c_trig_ops_equiv"] = float(m.group(1))
-        m = re.search(r"tier toas_per_sec: ([\d.]+)", text)
-        if m:
-            rates["toas_per_sec"] = float(m.group(1))
+        for key in ("toas_per_sec", "z2_trials_per_sec_poly",
+                    "z2_trials_per_sec_pallas"):
+            m = re.search(rf"tier {key}: ([\d.]+)", text)
+            if m:
+                rates[key] = float(m.group(1))
 
     rates = {k: v for k, v in rates.items() if v is not None}
     dest = repo / "docs" / "onchip_rates.json"

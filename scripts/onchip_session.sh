@@ -7,6 +7,12 @@
 # Each stage logs to <outdir>/<stage>.log and the JSON results aggregate in
 # <outdir>/results.jsonl. Stages continue on failure (a late wedge must not
 # discard earlier results).
+#
+# CRIMP_TPU_SESSION_DRYRUN=1 runs the SAME orchestration (stage order,
+# logging, results.jsonl, extract_rates wiring) entirely on CPU at tiny
+# scale, never touching the relay — round 3 lost 5 of 6 stages to
+# session commands that had never executed; this makes that class of
+# failure reproducible off-chip in ~10 min.
 
 set -u
 cd "$(dirname "$0")/.."
@@ -14,8 +20,17 @@ OUT="${1:-onchip_results}"
 mkdir -p "$OUT"
 RESULTS="$OUT/results.jsonl"
 : > "$RESULTS"
+DRY="${CRIMP_TPU_SESSION_DRYRUN:-0}"
 
 health_ok() {
+    if [ "$DRY" = "1" ]; then
+        echo "[dryrun] relay untouched" > "$OUT/health.log"
+        return 0
+    fi
+    _health_probe
+}
+
+_health_probe() {
     # A wedged relay HANGS rather than erroring; only a timeout can detect
     # it. Probe in a subprocess we are willing to lose. A successful probe
     # leaves the round's device-enumeration artifact (health.log) with no
@@ -88,26 +103,41 @@ fi
 # the scale demonstrations, then tuning/tier — a mid-session relay wedge
 # must cost the least important stages.
 
-# 1) the official bench workload on the chip
-stage bench 2400 python bench.py
+if [ "$DRY" = "1" ]; then
+    # the same six stages, CPU-pinned and tiny (the bench scales itself
+    # down when told the platform is cpu; the tier's FORCE_CPU mode skips
+    # the recorded-rate guards; the A/B stage is expected to fail on CPU
+    # at the Pallas point — non-interpret Pallas needs a TPU — which also
+    # exercises the failed-stage path end to end)
+    stage bench 2400 env CRIMP_TPU_BENCH_PLATFORM=cpu python bench.py
+    stage config3 900 python scripts/run_scale_configs.py --config 3 --scale 0.002 --cpu
+    stage config5 900 python scripts/run_scale_configs.py --config 5 --scale 0.001 --cpu
+    stage tune_toafit 1200 python scripts/tune_toafit.py --events 500 --segments 4 --res 100 --repeat 1 --cpu
+    stage tpu_tier 2400 env CRIMP_TPU_RUN_TPU_TESTS=1 CRIMP_TPU_TIER_FORCE_CPU=1 \
+        python -m pytest tests/test_tpu_tier.py -m tpu -q -s
+    stage sweep_blocks 1800 python scripts/sweep_blocks.py --events 20000 --trials 2000 --cpu
+else
+    # 1) the official bench workload on the chip
+    stage bench 2400 python bench.py
 
-# 2) BASELINE scale configs 3 and 5 at full scale
-stage config3 2400 python scripts/run_scale_configs.py --config 3
-stage config5 3600 python scripts/run_scale_configs.py --config 5
+    # 2) BASELINE scale configs 3 and 5 at full scale
+    stage config3 2400 python scripts/run_scale_configs.py --config 3
+    stage config5 3600 python scripts/run_scale_configs.py --config 5
 
-# 3) ToAFitConfig sweep at the real shape (defaults decision)
-stage tune_toafit 3600 python scripts/tune_toafit.py
+    # 3) ToAFitConfig sweep at the real shape (defaults decision)
+    stage tune_toafit 3600 python scripts/tune_toafit.py
 
-# 4) opportunistic TPU test tier (C_trig micro, hw/poly/Pallas A/B,
-#    full-res ToA batch, fast-path-vs-f64 bound)
-# five subprocess tests, the A/B alone budgeted 1800 s — give the stage
-# room for a slow-compiling build rather than losing the tier artifacts
-stage tpu_tier 4500 env CRIMP_TPU_RUN_TPU_TESTS=1 python -m pytest tests/test_tpu_tier.py -m tpu -q -s
+    # 4) opportunistic TPU test tier (C_trig micro, hw/poly/Pallas A/B,
+    #    full-res ToA batch, fast-path-vs-f64 bound)
+    # five subprocess tests, the A/B alone budgeted 1800 s — give the stage
+    # room for a slow-compiling build rather than losing the tier artifacts
+    stage tpu_tier 4500 env CRIMP_TPU_RUN_TPU_TESTS=1 python -m pytest tests/test_tpu_tier.py -m tpu -q -s
 
-# 5) block-size sweep for the poly-trig fast path + Pallas tile knobs
-#    (VERDICT r3 item 6: the 2^15/512 defaults predate poly trig);
-#    ~34 points each paying a fresh compile at bench scale
-stage sweep_blocks 3600 python scripts/sweep_blocks.py --pallas
+    # 5) block-size sweep for the poly-trig fast path + Pallas tile knobs
+    #    (VERDICT r3 item 6: the 2^15/512 defaults predate poly trig);
+    #    ~34 points each paying a fresh compile at bench scale
+    stage sweep_blocks 3600 python scripts/sweep_blocks.py --pallas
+fi
 
 # 6) turn the session into the official perf-guard record (no chip needed;
 #    refuses CPU-fallback benches). Not a stage(): a refusal rc must be

@@ -226,6 +226,25 @@ class TestPolyTrig:
         monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "0")
         assert not fasttrig.poly_trig_enabled()
         assert fasttrig.poly_trig_enabled(True)
+        # 'auto' spells the documented default explicitly
+        monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "auto")
+        assert fasttrig.poly_trig_enabled() == (jax.default_backend() == "tpu")
+        # a typo must raise, not silently pick the backend default (on TPU
+        # that would silently ENABLE poly trig)
+        monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "of")
+        with pytest.raises(ValueError, match="CRIMP_TPU_POLY_TRIG"):
+            fasttrig.poly_trig_enabled()
+
+    def test_grid_blocks_env_override(self, monkeypatch):
+        """CRIMP_TPU_GRID_BLOCKS applies a sweep winner without a code edit."""
+        monkeypatch.delenv("CRIMP_TPU_GRID_BLOCKS", raising=False)
+        assert search._env_blocks(1 << 15, 512) == (1 << 15, 512)
+        monkeypatch.setenv("CRIMP_TPU_GRID_BLOCKS", "65536,1024")
+        assert search._env_blocks(1 << 15, 512) == (65536, 1024)
+        for bad in ("65536", "a,b", "0,512", "512,-1"):
+            monkeypatch.setenv("CRIMP_TPU_GRID_BLOCKS", bad)
+            with pytest.raises(ValueError, match="CRIMP_TPU_GRID_BLOCKS"):
+                search._env_blocks(1 << 15, 512)
 
     def test_z2_poly_matches_hardware_trig(self, sim_events, monkeypatch):
         """Statistic parity: the poly-trig scan must agree with the hardware
@@ -270,6 +289,24 @@ class TestPallasZ2:
         assert pallas.shape == (n_freq,)
         np.testing.assert_allclose(pallas, xla, rtol=2e-3, atol=0.05)
         assert int(np.argmax(pallas)) == int(np.argmax(xla))
+
+    def test_interpret_2d_matches_xla_2d_grid(self, sim_events):
+        """The 2-D (fdot x freq) Pallas wrapper must reproduce the XLA 2-D
+        fast path — the BASELINE config-3 shape on the native layer."""
+        from crimp_tpu.ops.pallas_z2 import z2_power_2d_grid_pallas
+
+        sec = (sim_events - sim_events.mean())[:4096]
+        n_freq = 280
+        freqs = np.linspace(0.2495, 0.2505, n_freq)
+        fdots = np.array([-1e-10, 0.0, 1e-10])
+        f0, df = search.uniform_grid(freqs)
+        xla = np.asarray(search.z2_power_2d_grid(sec, f0, df, n_freq, fdots, 2))
+        got = np.asarray(z2_power_2d_grid_pallas(
+            sec, f0, df, n_freq, fdots, 2, interpret=True))
+        assert got.shape == (3, n_freq)
+        np.testing.assert_allclose(got, xla, rtol=2e-3, atol=0.05)
+        # the fdot axis must actually differentiate (nonzero quadratic term)
+        assert not np.allclose(got[0], got[1])
 
     def test_interpret_multi_tile_chunks(self, sim_events):
         """More trial tiles than one chunk: the chunked f64 base-row

@@ -74,11 +74,11 @@ def run_on_chip(body: str, timeout: float = 900.0) -> dict:
         # Dry-run mode: validate the tier bodies without touching the relay
         # (a wedged relay hangs the subprocess for its full timeout). The
         # site hook overrides the JAX_PLATFORMS env var, so the platform
-        # must be forced through jax.config before any array op — same
-        # mechanism as tests/conftest.py and __graft_entry__.dryrun_multichip.
+        # must be pinned through jax.config — the one shared workaround in
+        # crimp_tpu/utils/platform.py.
         body = (
-            'import jax; jax.config.update("jax_platforms", "cpu")\n'
-            + textwrap.dedent(body)
+            "from crimp_tpu.utils.platform import force_cpu_platform; "
+            "force_cpu_platform()\n" + textwrap.dedent(body)
         )
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     out = subprocess.run(
@@ -246,6 +246,12 @@ class TestOnChipToABatch:
             f"poly: {result['trials_per_sec_poly']}, "
             f"pallas: {result['trials_per_sec_pallas']}"
         )
+        # parsed by scripts/extract_rates.py: the guard rates must come from
+        # THIS canonical workload (benchwork.ab_workload), not bench.py's
+        # campaign surrogate
+        for key in ("trials_per_sec_poly", "trials_per_sec_pallas"):
+            if result.get(key) is not None:
+                print(f"tier z2_{key}: {result[key]:.1f}")
         assert result.get("pallas_error") is None, result["pallas_error"]
         assert result.get("poly_error") is None, result["poly_error"]
         assert result["poly_max_rel_dev"] < 5e-3
