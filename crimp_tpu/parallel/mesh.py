@@ -85,10 +85,13 @@ def auto_mesh(min_devices: int = 2) -> Mesh | None:
     """
     if not sharding_enabled():
         return None
-    devices = jax.devices()
-    if len(devices) < min_devices:
-        return None
-    return build_mesh(devices)
+    # Lazy import (multihost builds on this module): same shape contract
+    # as build_mesh, but with ICI-topology-aware device order — and the
+    # DCN-hybrid layout when the job spans slices. It owns the
+    # min-devices threshold (returns None below it).
+    from crimp_tpu.parallel.multihost import auto_global_mesh
+
+    return auto_global_mesh(min_devices)
 
 
 def build_mesh(

@@ -1,0 +1,147 @@
+"""Multi-host / multi-slice mesh construction (ICI- and DCN-aware).
+
+``parallel.mesh`` defines the framework's sharding semantics (event axis
+psum-reduced, trial/segment axes communication-free) on ANY mesh; this
+module builds the meshes that make those semantics fast at pod scale:
+
+- ``initialize()`` — one-call ``jax.distributed`` bring-up so every host
+  in a pod slice (or multi-slice job) sees the GLOBAL device list. On
+  TPU pods all arguments auto-detect from the environment.
+- ``topology_mesh()`` — the single-slice mesh, with device order chosen
+  by ``mesh_utils.create_device_mesh`` so the event axis (the psum axis,
+  the only one that communicates per block) rides contiguous ICI rings
+  rather than the arbitrary enumeration order a plain reshape gives.
+- ``hybrid_mesh()`` — the multi-slice mesh: the TRIAL axis spans slices
+  over DCN (its only traffic is the final result gather) while the
+  EVENT axis stays inside each slice on ICI. This is exactly the
+  "collectives ride ICI, not DCN" layout the sharded kernels assume.
+
+The reference has no distributed layer at all (SURVEY.md §2.4); this is
+the TPU-native substitute for the NCCL/MPI backend a CUDA framework
+would carry. Correctness never depends on device order — the suite pins
+mesh-shape invariance — so these builders are pure performance layout.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh
+
+from crimp_tpu.parallel.mesh import EVENT_AXIS, TRIAL_AXIS, build_mesh
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None,
+               **kwargs) -> None:
+    """Bring up jax.distributed so jax.devices() is the global pod view.
+
+    On TPU pods every argument auto-detects (call with no arguments in
+    each host process before any other JAX call); elsewhere pass the
+    coordinator's ``host:port``, the process count, and this process's
+    rank. Safe to document-and-skip on a single host: calling JAX
+    without it simply keeps the local device view.
+    """
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        **kwargs,
+    )
+
+
+def topology_mesh(devices=None, event_parallel: int | None = None) -> Mesh:
+    """A 2-D (events x trials) mesh with ICI-topology-aware device order.
+
+    Same shape contract as ``mesh.build_mesh`` (all devices on the event
+    axis by default); the difference is only the order devices are laid
+    onto the grid: ``mesh_utils.create_device_mesh`` places neighbors on
+    the event axis so the per-block ``psum`` rides physical ICI rings.
+    Falls back to the plain reshape ordering wherever the topology is
+    unknown (CPU/virtual devices).
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if event_parallel is None:
+        event_parallel = n
+    if n % event_parallel != 0:
+        raise ValueError(f"{n} devices do not tile into event_parallel={event_parallel}")
+    try:
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_device_mesh(
+            (event_parallel, n // event_parallel), devices=devices
+        )
+    except Exception:
+        # virtual/CPU devices carry no coords; order cannot matter there —
+        # same contract, enumeration-order layout
+        return build_mesh(devices, event_parallel=event_parallel)
+    return Mesh(grid, (EVENT_AXIS, TRIAL_AXIS))
+
+
+def hybrid_mesh(event_parallel_per_slice: int | None = None, devices=None) -> Mesh:
+    """A multi-slice (events x trials) mesh: trials across DCN, events on ICI.
+
+    For jobs spanning TPU slices (after ``initialize()``): each slice
+    keeps a full event-sharded psum group on its own ICI, and the trial
+    axis — whose only communication is the final gather of per-trial
+    statistics — spans the slow DCN links between slices. Requires
+    devices that report ``slice_index`` (real multi-slice TPU jobs);
+    raises ValueError otherwise so callers can fall back to
+    ``topology_mesh``.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    slice_ids = {getattr(d, "slice_index", None) for d in devices}
+    if None in slice_ids or len(slice_ids) < 2:
+        raise ValueError(
+            "hybrid_mesh needs a multi-slice job (devices reporting "
+            "slice_index); use topology_mesh on a single slice"
+        )
+    from jax.experimental import mesh_utils
+
+    n_slices = len(slice_ids)
+    per_slice = len(devices) // n_slices
+    if event_parallel_per_slice is None:
+        event_parallel_per_slice = per_slice
+    if per_slice % event_parallel_per_slice != 0:
+        raise ValueError(
+            f"{per_slice} devices per slice do not tile into "
+            f"event_parallel_per_slice={event_parallel_per_slice}"
+        )
+    grid = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(event_parallel_per_slice, per_slice // event_parallel_per_slice),
+        dcn_mesh_shape=(1, n_slices),
+        devices=devices,
+    )
+    return Mesh(grid, (EVENT_AXIS, TRIAL_AXIS))
+
+
+def auto_global_mesh(min_devices: int = 2) -> Mesh | None:
+    """Best global mesh for this process's device view, or None below
+    ``min_devices``: hybrid across slices when the job is multi-slice,
+    else the ICI-topology-aware single-slice mesh."""
+    import jax
+
+    devices = jax.devices()
+    if len(devices) < min_devices:
+        return None
+    try:
+        return hybrid_mesh(devices=devices)
+    except ValueError:
+        return topology_mesh(devices=devices)
+
+
+__all__ = [
+    "initialize",
+    "topology_mesh",
+    "hybrid_mesh",
+    "auto_global_mesh",
+    "build_mesh",
+]

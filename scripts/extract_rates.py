@@ -74,13 +74,16 @@ def main() -> int:
     # Ratchet, don't overwrite: keep the BEST recorded value per key so a
     # within-guard (sub-2x) regression can never lower the baseline and
     # compound silently across sessions. "Best" is key-specific: rates go
-    # up, C_trig (op-cost) goes down.
+    # up, C_trig (op-cost) goes down. Only keys the CURRENT extractor
+    # writes participate — an old record's keys with retired names (or
+    # changed workload semantics) must not leak into the guard.
     if dest.exists():
         old = json.loads(dest.read_text())
-        for key, val in old.items():
-            if not isinstance(val, (int, float)) or key not in rates:
-                rates.setdefault(key, val)
-            elif key == "c_trig_ops_equiv":
+        for key in rates:
+            val = old.get(key)
+            if not isinstance(val, (int, float)) or not isinstance(rates[key], (int, float)):
+                continue
+            if key == "c_trig_ops_equiv":
                 rates[key] = min(rates[key], val)
             else:
                 rates[key] = max(rates[key], val)

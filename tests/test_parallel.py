@@ -255,6 +255,57 @@ class TestAutoShardProduct:
             )
 
 
+class TestMultihostMeshes:
+    """ICI/DCN-aware mesh builders (parallel/multihost.py). Correctness can
+    never depend on device ORDER (mesh-shape invariance pins that), so
+    these check the shape contract, the fallback paths, and that the
+    sharded kernels accept topology-built meshes."""
+
+    def test_topology_mesh_shape_contract(self):
+        from crimp_tpu.parallel import multihost
+
+        mesh = multihost.topology_mesh(jax.devices()[:8], event_parallel=4)
+        assert dict(mesh.shape) == {"events": 4, "trials": 2}
+        with pytest.raises(ValueError, match="do not tile"):
+            multihost.topology_mesh(jax.devices()[:8], event_parallel=3)
+
+    def test_topology_mesh_runs_sharded_kernel(self, events, freqs):
+        from crimp_tpu.parallel import multihost
+
+        mesh = multihost.topology_mesh(jax.devices()[:8], event_parallel=2)
+        expected = np.asarray(
+            search.z2_power(jnp.asarray(events), jnp.asarray(freqs), 2,
+                            trig_dtype=jnp.float64)
+        )
+        got = pmesh.z2_sharded(events, freqs, nharm=2, mesh=mesh,
+                               trig_dtype=jnp.float64)
+        np.testing.assert_allclose(got, expected, rtol=1e-12, atol=1e-9)
+
+    def test_hybrid_mesh_requires_multislice(self):
+        from crimp_tpu.parallel import multihost
+
+        # virtual CPU devices report no slice_index -> explicit refusal,
+        # so auto_global_mesh falls back to the single-slice builder
+        with pytest.raises(ValueError, match="multi-slice"):
+            multihost.hybrid_mesh(devices=jax.devices()[:8])
+        mesh = multihost.auto_global_mesh()
+        assert mesh is not None and dict(mesh.shape)["events"] == len(jax.devices())
+
+    def test_auto_mesh_uses_topology_builder(self, monkeypatch):
+        from crimp_tpu.parallel import multihost
+
+        calls = []
+        real = multihost.auto_global_mesh
+
+        def spy(*a, **kw):
+            calls.append(1)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(multihost, "auto_global_mesh", spy)
+        mesh = pmesh.auto_mesh()
+        assert calls and mesh is not None
+
+
 class TestDryrun:
     def test_driver_dryrun_8(self):
         import importlib.util
