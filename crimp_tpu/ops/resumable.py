@@ -1,0 +1,219 @@
+"""Checkpointed, resumable periodicity scans.
+
+The full-scale BASELINE workloads (1e6-trial 2-D grids, 1e8-event H-test
+blind searches) run minutes-to-hours depending on hardware, and the
+accelerator can disappear mid-run (preemption; a wedged relay — the
+round-3 failure mode). The trial axis is embarrassingly parallel, so a
+scan is naturally a sequence of independent trial chunks: this module
+persists each chunk's result as it completes and recomputes only the
+missing ones on restart.
+
+Layout of a checkpoint store (a directory):
+
+    manifest.json   problem fingerprint (event hash, grid, nharm, fdots,
+                    chunking) — resume REFUSES a store whose fingerprint
+                    does not match, so stale chunks can never mix into a
+                    different problem's result
+    chunk_00042.npy power rows for trial chunk 42, shape (n_fdot, k)
+
+Chunks are written atomically (tmp + rename). The statistic is identical
+to the unchunked kernels: each chunk is a contiguous frequency range, so
+the uniform-grid fast path applies per chunk (same per-tile f64-row
+decomposition; chunk boundaries align to the trial grid).
+
+Reference parity note: the reference has no resumable scans (its serial
+loops just rerun, periodsearch.py:63-125); this is TPU-native
+infrastructure in the spirit of SURVEY §5's checkpoint/resume row.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+
+import numpy as np
+
+CHUNK_TRIALS = 50_000
+
+
+def _fingerprint(times: np.ndarray, freqs: np.ndarray, fdots: np.ndarray,
+                 nharm: int, chunk_trials: int) -> dict:
+    t = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
+    return {
+        "version": 1,
+        "n_events": int(t.shape[0]),
+        "events_sha256": hashlib.sha256(t.tobytes()).hexdigest(),
+        "n_freq": int(len(freqs)),
+        "f_first": float(freqs[0]),
+        "f_last": float(freqs[-1]),
+        "fdots": [float(f) for f in np.atleast_1d(fdots)],
+        "nharm": int(nharm),
+        "chunk_trials": int(chunk_trials),
+    }
+
+
+class ResumableScan:
+    """Z^2_n over a (fdot x frequency) grid, checkpointed per trial chunk.
+
+    ``fdots=None`` gives the 1-D scan (one all-zero fdot row, squeezed on
+    return). ``store=None`` disables checkpointing entirely (pure
+    chunked compute). Usage::
+
+        scan = ResumableScan(times_sec, freqs, nharm=2, store="ckpt_dir")
+        power = scan.run()      # computes missing chunks, returns (n_freq,)
+    """
+
+    def __init__(self, times, freqs, nharm: int = 2, fdots=None,
+                 store: str | None = None, chunk_trials: int = CHUNK_TRIALS,
+                 poly: bool | None = None, statistic: str = "z2"):
+        if statistic not in ("z2", "h"):
+            raise ValueError(f"statistic must be 'z2' or 'h', got {statistic!r}")
+        if statistic == "h" and fdots is not None:
+            raise ValueError("the H-test scan is 1-D (fdots unsupported)")
+        self.times = np.asarray(times, dtype=np.float64)
+        self.freqs = np.asarray(freqs, dtype=np.float64)
+        self.nharm = int(nharm)
+        self.statistic = statistic
+        self._squeeze = fdots is None
+        self.fdots = np.zeros(1) if fdots is None else np.atleast_1d(
+            np.asarray(fdots, dtype=np.float64))
+        self.chunk_trials = int(chunk_trials)
+        from crimp_tpu.ops import fasttrig, search
+
+        # Resolve every numeric-mode knob NOW and pin it in the store
+        # fingerprint: chunks computed under different trig/precision modes
+        # (poly flipped between runs, fast path toggled, blocks re-tuned)
+        # must never silently mix into one power array.
+        self.poly = fasttrig.poly_trig_enabled(poly)
+        self._fastpath = (search.uniform_grid(self.freqs) is not None
+                          and search.grid_fastpath_enabled(self.nharm))
+        self._numeric_mode = {
+            "poly_trig": bool(self.poly),
+            "grid_fastpath": bool(self._fastpath),
+            "grid_blocks": [search.GRID_EVENT_BLOCK, search.GRID_TRIAL_BLOCK],
+        }
+        self.store = pathlib.Path(store) if store is not None else None
+        self.n_chunks = -(-len(self.freqs) // self.chunk_trials)
+        if self.store is not None:
+            self._open_store()
+
+    # -- store management ---------------------------------------------------
+
+    def _open_store(self) -> None:
+        fp = _fingerprint(self.times, self.freqs, self.fdots, self.nharm,
+                          self.chunk_trials)
+        fp["statistic"] = self.statistic
+        fp["numeric_mode"] = self._numeric_mode
+        manifest = self.store / "manifest.json"
+        if manifest.exists():
+            existing = json.loads(manifest.read_text())
+            if existing != fp:
+                raise ValueError(
+                    f"checkpoint store {self.store} belongs to a different "
+                    "problem (manifest fingerprint mismatch); refusing to mix "
+                    "chunks — use a fresh store directory"
+                )
+        else:
+            self.store.mkdir(parents=True, exist_ok=True)
+            tmp = manifest.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(fp, indent=2))
+            tmp.rename(manifest)
+
+    def _chunk_path(self, i: int) -> pathlib.Path:
+        return self.store / f"chunk_{i:05d}.npy"
+
+    def done_chunks(self) -> list[int]:
+        if self.store is None:
+            return []
+        return sorted(
+            int(p.stem.split("_")[1]) for p in self.store.glob("chunk_*.npy")
+        )
+
+    # -- compute ------------------------------------------------------------
+
+    def _mesh(self, n_trials_chunk: int):
+        """Auto-shard mesh for one chunk, mirroring PeriodSearch._mesh."""
+        from crimp_tpu.ops.search import MIN_SHARD_PAIRS
+        from crimp_tpu.parallel import mesh as pmesh
+
+        pairs = len(self.times) * n_trials_chunk * len(self.fdots)
+        if pairs < MIN_SHARD_PAIRS:
+            return None
+        return pmesh.auto_mesh()
+
+    def _compute_chunk(self, i: int) -> np.ndarray:
+        """(n_fdot, k) Z^2 (or (1, k) H) rows for trial chunk i.
+
+        Same dispatch as PeriodSearch: multi-device hosts auto-shard the
+        event axis (psum combines), single-device hosts take the blockwise
+        kernels; the uniform-grid fast path applies per chunk either way
+        (a chunk is a contiguous range of the full grid)."""
+        import jax.numpy as jnp
+
+        from crimp_tpu.ops import search
+
+        lo = i * self.chunk_trials
+        chunk = self.freqs[lo:lo + self.chunk_trials]
+        poly = self.poly
+        mesh = self._mesh(len(chunk))
+        if mesh is not None:
+            from crimp_tpu.parallel import mesh as pmesh
+
+            # pass the PINNED fast-path decision (it is part of the store
+            # fingerprint), not the auto default
+            if self.statistic == "h":
+                rows = pmesh.h_sharded(self.times, chunk, self.nharm,
+                                       mesh=mesh, poly=poly,
+                                       use_fastpath=self._fastpath)[None, :]
+            else:
+                rows = pmesh.z2_2d_sharded(self.times, chunk, self.fdots,
+                                           self.nharm, mesh=mesh, poly=poly,
+                                           use_fastpath=self._fastpath)
+            return np.asarray(rows)
+        grid = search.uniform_grid(self.freqs)  # chunk grids inherit df
+        if self.statistic == "h":
+            if self._fastpath:
+                rows = search.h_power_grid(
+                    self.times, float(chunk[0]), grid[1], len(chunk),
+                    self.nharm, poly=poly,
+                )[None, :]
+            else:
+                rows = search.h_power(
+                    jnp.asarray(self.times), jnp.asarray(chunk), self.nharm,
+                    poly=poly,
+                )[None, :]
+        elif self._fastpath:
+            rows = search.z2_power_2d_grid(
+                jnp.asarray(self.times), float(chunk[0]), grid[1], len(chunk),
+                jnp.asarray(self.fdots), self.nharm, poly=poly,
+            )
+        else:
+            rows = search.z2_power_2d(
+                jnp.asarray(self.times), jnp.asarray(chunk),
+                jnp.asarray(self.fdots), self.nharm, poly=poly,
+            )
+        return np.asarray(rows)
+
+    def run(self, progress=None) -> np.ndarray:
+        """Compute all missing chunks (checkpointing each) and return the
+        assembled (n_fdot, n_freq) power — or (n_freq,) for the 1-D scan.
+        ``progress`` (optional callable) receives (chunk_index, n_chunks)
+        after each chunk completes."""
+        done = set(self.done_chunks())
+        parts: list[np.ndarray | None] = [None] * self.n_chunks
+        for i in range(self.n_chunks):
+            if i in done:
+                parts[i] = np.load(self._chunk_path(i))
+                continue
+            rows = self._compute_chunk(i)
+            if self.store is not None:
+                tmp = self._chunk_path(i).with_suffix(".npy.tmp")
+                with open(tmp, "wb") as fh:  # np.save(path) would append .npy
+                    np.save(fh, rows)
+                tmp.rename(self._chunk_path(i))
+            parts[i] = rows
+            if progress is not None:
+                progress(i, self.n_chunks)
+        power = np.concatenate(parts, axis=1)
+        return power[0] if self._squeeze else power

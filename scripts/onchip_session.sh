@@ -120,9 +120,11 @@ else
     # 1) the official bench workload on the chip
     stage bench 2400 python bench.py
 
-    # 2) BASELINE scale configs 3 and 5 at full scale
-    stage config3 2400 python scripts/run_scale_configs.py --config 3
-    stage config5 3600 python scripts/run_scale_configs.py --config 5
+    # 2) BASELINE scale configs 3 and 5 at full scale, checkpointed per
+    #    trial chunk: a wedge mid-scan loses one chunk, and a watcher
+    #    relaunch of the session resumes instead of restarting
+    stage config3 2400 python scripts/run_scale_configs.py --config 3 --checkpoint "$OUT/ckpt"
+    stage config5 3600 python scripts/run_scale_configs.py --config 5 --checkpoint "$OUT/ckpt"
 
     # 3) ToAFitConfig sweep at the real shape (defaults decision)
     stage tune_toafit 3600 python scripts/tune_toafit.py

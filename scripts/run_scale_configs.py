@@ -78,7 +78,7 @@ def synth_events(n_events: int, span_s: float, pulsed_frac: float, seed: int,
     return np.sort(t)
 
 
-def config3(scale: float) -> dict:
+def config3(scale: float, checkpoint: str | None = None) -> dict:
     """1e7-event magnetar, 2-D (nu, nudot) Z^2, 1e6 trials."""
     from crimp_tpu.ops import search
 
@@ -94,15 +94,36 @@ def config3(scale: float) -> dict:
     # convention: magnitudes, spin-down sign applied inside)
     log_fdots = np.linspace(-14.6, -13.4, n_fdot)
 
-    ps = search.PeriodSearch(times, freqs, 2)
     log(f"[config3] compiling + first run: {n_freq} x {n_fdot} = {n_freq*n_fdot} trials ...")
     t0 = time.perf_counter()
-    rows, _ = ps.twod_ztest(log_fdots)
-    wall = time.perf_counter() - t0
-    peak = rows[np.argmax(rows[:, 2])]
+    extra = {}
+    if checkpoint:
+        # wedge-tolerant path: per-trial-chunk checkpoints, resume skips
+        # completed chunks (so the measured wall reflects remaining work —
+        # resumed_chunks in the output flags a partially-resumed wall)
+        from crimp_tpu.ops.resumable import ResumableScan
+
+        # chunk_trials must be well under n_freq (25k at full scale) or the
+        # whole scan is one chunk and a wedge still loses everything
+        scan = ResumableScan(
+            times - times.mean(), freqs, nharm=2, fdots=-(10.0 ** log_fdots),
+            store=checkpoint, chunk_trials=2_500,
+        )
+        extra = {"resumed_chunks": len(scan.done_chunks()),
+                 "total_chunks": scan.n_chunks}
+        power_2d = scan.run(
+            progress=lambda i, n: log(f"[config3] chunk {i + 1}/{n} done"))
+        wall = time.perf_counter() - t0
+        i_fd, i_f = np.unravel_index(np.argmax(power_2d), power_2d.shape)
+        peak = (freqs[i_f], log_fdots[i_fd], power_2d[i_fd, i_f])
+    else:
+        ps = search.PeriodSearch(times, freqs, 2)
+        rows, _ = ps.twod_ztest(log_fdots)
+        wall = time.perf_counter() - t0
+        peak = rows[np.argmax(rows[:, 2])]
+        power_2d = rows[:, 2].reshape(n_fdot, n_freq)
     # per-fdot-row frequency recovery: the global peak's nu must sit on the
     # injection's grid point (grid-scaled check, not a fixed Hz tolerance)
-    power_2d = rows[:, 2].reshape(n_fdot, n_freq)
     ok_f = peak_on_injection(freqs, power_2d[int(np.argmax(np.max(power_2d, axis=1)))])
     ok_fd = abs(-(10.0 ** peak[1]) - FDOT) < 0.5 * abs(FDOT)
     return {
@@ -116,10 +137,11 @@ def config3(scale: float) -> dict:
         "peak_freq_hz": float(peak[0]),
         "peak_log10_fdot": float(peak[1]),
         "recovers_injection": bool(ok_f and ok_fd),
+        **extra,
     }
 
 
-def config5(scale: float) -> dict:
+def config5(scale: float, checkpoint: str | None = None) -> dict:
     """1e8-event multi-mission H-test blind search (nharm=20)."""
     from crimp_tpu.ops import search
 
@@ -134,10 +156,23 @@ def config5(scale: float) -> dict:
 
     n_freq = max(int(20_000 * scale), 64)
     freqs = centered_freq_grid(span, n_freq)
-    ps = search.PeriodSearch(times, freqs, 20)  # blind: generous harmonics
     log(f"[config5] compiling + first run: H-test over {n_freq} trials x {len(times)} events ...")
     t0 = time.perf_counter()
-    power = ps.htest()
+    extra = {}
+    if checkpoint:
+        from crimp_tpu.ops.resumable import ResumableScan
+
+        scan = ResumableScan(
+            times - times.mean(), freqs, nharm=20, statistic="h",
+            store=checkpoint, chunk_trials=5_000,
+        )
+        extra = {"resumed_chunks": len(scan.done_chunks()),
+                 "total_chunks": scan.n_chunks}
+        power = scan.run(
+            progress=lambda i, n: log(f"[config5] chunk {i + 1}/{n} done"))
+    else:
+        ps = search.PeriodSearch(times, freqs, 20)  # blind: generous harmonics
+        power = ps.htest()
     wall = time.perf_counter() - t0
     i = int(np.argmax(power))
     return {
@@ -151,6 +186,7 @@ def config5(scale: float) -> dict:
         "peak_H": round(float(power[i]), 1),
         "peak_freq_hz": float(freqs[i]),
         "recovers_injection": peak_on_injection(freqs, power),
+        **extra,
     }
 
 
@@ -158,6 +194,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--config", default="all", choices=["3", "5", "all"])
+    ap.add_argument("--checkpoint", default=None, metavar="DIR",
+                    help="opt-in per-trial-chunk checkpointing (ops.resumable): "
+                         "a wedge mid-scan loses one chunk, not the run; "
+                         "config-specific subdirectories are created")
+
     from crimp_tpu.utils.platform import add_cpu_flag, force_cpu_platform
 
     add_cpu_flag(ap)
@@ -168,10 +209,12 @@ def main():
     if args.cpu:
         force_cpu_platform()
     log(f"[scale_configs] devices: {jax.devices()}")
+    ckpt = lambda name: (str(pathlib.Path(args.checkpoint) / name)
+                         if args.checkpoint else None)
     if args.config in ("3", "all"):
-        print(json.dumps(config3(args.scale)), flush=True)
+        print(json.dumps(config3(args.scale, checkpoint=ckpt("config3"))), flush=True)
     if args.config in ("5", "all"):
-        print(json.dumps(config5(args.scale)), flush=True)
+        print(json.dumps(config5(args.scale, checkpoint=ckpt("config5"))), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,128 @@
+"""Checkpointed resumable scans (ops/resumable.py).
+
+Contract: chunked == unchunked statistic, resume computes ONLY missing
+chunks, and a store can never be reused for a different problem.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from crimp_tpu.ops import search  # noqa: E402
+from crimp_tpu.ops.resumable import ResumableScan  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def events():
+    rng = np.random.RandomState(11)
+    n = 8000
+    base = rng.uniform(0, 86400.0, n)
+    pulsed = rng.rand(n) < 0.4
+    phase = rng.vonmises(0.0, 2.0, n) / (2 * np.pi)
+    times = np.where(pulsed, (np.round(base * 0.1432) + phase) / 0.1432, base)
+    return np.sort(times) - 43200.0
+
+
+class TestResumableScan:
+    def test_chunked_matches_unchunked_1d(self, events):
+        freqs = np.linspace(0.1428, 0.1436, 900)  # 3 chunks of 400
+        expected = np.asarray(search.z2_power(
+            jax.numpy.asarray(events), jax.numpy.asarray(freqs), 2))
+        got = ResumableScan(events, freqs, nharm=2, chunk_trials=400).run()
+        assert got.shape == expected.shape == (900,)
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-3)
+        assert int(np.argmax(got)) == int(np.argmax(expected))
+
+    def test_chunked_matches_unchunked_2d(self, events):
+        freqs = np.linspace(0.1428, 0.1436, 500)
+        fdots = np.array([-1e-10, 0.0])
+        expected = np.asarray(search.z2_power_2d(
+            jax.numpy.asarray(events), jax.numpy.asarray(freqs),
+            jax.numpy.asarray(fdots), 2))
+        got = ResumableScan(events, freqs, nharm=2, fdots=fdots,
+                            chunk_trials=200).run()
+        assert got.shape == (2, 500)
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-3)
+
+    def test_chunked_matches_unchunked_htest(self, events):
+        freqs = np.linspace(0.1428, 0.1436, 500)
+        expected = np.asarray(search.h_power(
+            jax.numpy.asarray(events), jax.numpy.asarray(freqs), 10,
+            trig_dtype=jax.numpy.float64))
+        got = ResumableScan(events, freqs, nharm=10, statistic="h",
+                            chunk_trials=200).run()
+        assert got.shape == (500,)
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-3)
+        with pytest.raises(ValueError, match="1-D"):
+            ResumableScan(events, freqs, nharm=10, statistic="h",
+                          fdots=np.array([0.0]))
+
+    def test_resume_recomputes_only_missing_chunks(self, events, tmp_path):
+        freqs = np.linspace(0.1428, 0.1436, 600)
+        store = tmp_path / "ckpt"
+        scan = ResumableScan(events, freqs, nharm=2, store=str(store),
+                             chunk_trials=200)
+        full = scan.run()
+        assert scan.done_chunks() == [0, 1, 2]
+
+        # lose the middle chunk (simulates a wedge mid-run)
+        (store / "chunk_00001.npy").unlink()
+        recomputed = []
+        scan2 = ResumableScan(events, freqs, nharm=2, store=str(store),
+                              chunk_trials=200)
+        assert scan2.done_chunks() == [0, 2]
+        resumed = scan2.run(progress=lambda i, n: recomputed.append(i))
+        assert recomputed == [1], "resume must touch only the missing chunk"
+        np.testing.assert_allclose(resumed, full, rtol=0, atol=0)
+
+    def test_store_refuses_different_problem(self, events, tmp_path):
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        ResumableScan(events, freqs, nharm=2, store=str(store),
+                      chunk_trials=200).run()
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=3, store=str(store),
+                          chunk_trials=200)
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events[:-1], freqs, nharm=2, store=str(store),
+                          chunk_trials=200)
+
+    def test_sharded_chunks_match_single_device(self, events, monkeypatch):
+        """Above the pair threshold each chunk auto-shards like PeriodSearch;
+        the assembled power must match the single-device result."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        freqs = np.linspace(0.1428, 0.1436, 600)
+        single = ResumableScan(events, freqs, nharm=2, chunk_trials=200).run()
+        monkeypatch.setattr(search, "MIN_SHARD_PAIRS", 1)
+        sharded = ResumableScan(events, freqs, nharm=2, chunk_trials=200).run()
+        np.testing.assert_allclose(sharded, single, rtol=1e-4, atol=1e-3)
+
+    def test_store_refuses_numeric_mode_change(self, events, tmp_path, monkeypatch):
+        """Chunks computed under different trig modes must never mix: a
+        store written with poly trig off refuses a resume with it forced on."""
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.delenv("CRIMP_TPU_POLY_TRIG", raising=False)
+        ResumableScan(events, freqs, nharm=2, store=str(store),
+                      chunk_trials=200).run()
+        monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "1")
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, store=str(store),
+                          chunk_trials=200)
+
+    def test_atomic_chunks_ignore_tmp_leftovers(self, events, tmp_path):
+        """A crash mid-write leaves only a .tmp file; resume must treat the
+        chunk as missing rather than loading a torn artifact."""
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        scan = ResumableScan(events, freqs, nharm=2, store=str(store),
+                             chunk_trials=200)
+        full = scan.run()
+        path = store / "chunk_00000.npy"
+        path.rename(store / "chunk_00000.npy.tmp")  # torn write remnant
+        scan2 = ResumableScan(events, freqs, nharm=2, store=str(store),
+                              chunk_trials=200)
+        assert scan2.done_chunks() == [1]
+        np.testing.assert_allclose(scan2.run(), full, rtol=0, atol=0)
