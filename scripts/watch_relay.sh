@@ -1,24 +1,45 @@
 #!/bin/bash
 # Watch the accelerator relay and launch the on-chip session the moment it
 # recovers. Probes every PERIOD seconds (default 600) with a 290 s budget;
-# a down relay HANGS the probe, so the timeout is the detector. Exits
-# after the session completes (or after MAX_HOURS of watching).
+# a down relay HANGS the probe, so the timeout is the detector.
 #
-# Usage: bash scripts/watch_relay.sh [outdir] [period_s] [max_hours]
+# A session whose results.jsonl shows any failed/skipped stage does NOT
+# end the watch: the watcher goes back to probing and relaunches (same
+# outdir) up to MAX_ATTEMPTS times — configs 3/5 checkpoint per trial
+# chunk, so a relaunch RESUMES rather than restarts them. Exits 0 on the
+# first fully-green session, 1 at the deadline/attempt cap.
+#
+# Usage: bash scripts/watch_relay.sh [outdir] [period_s] [max_hours] [max_attempts]
 
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-onchip_results}"
 PERIOD="${2:-600}"
 MAX_HOURS="${3:-8}"
+MAX_ATTEMPTS="${4:-3}"
 DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+ATTEMPTS=0
 
-echo "[watch] watching relay (period ${PERIOD}s, until $(date -u -d @${DEADLINE} +%H:%M 2>/dev/null || echo +${MAX_HOURS}h))"
+echo "[watch] watching relay (period ${PERIOD}s, until $(date -u -d @${DEADLINE} +%H:%M 2>/dev/null || echo +${MAX_HOURS}h), <=${MAX_ATTEMPTS} session attempts)"
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     if timeout 290 python -c "import jax; jax.devices()" > /dev/null 2>&1; then
-        echo "[watch] relay healthy at $(date -u +%H:%M:%S) — launching session"
+        ATTEMPTS=$(( ATTEMPTS + 1 ))
+        echo "[watch] relay healthy at $(date -u +%H:%M:%S) — session attempt ${ATTEMPTS}/${MAX_ATTEMPTS}"
         bash scripts/onchip_session.sh "$OUT"
-        exit $?
+        SESS_RC=$?
+        # green = the session itself exited 0 AND its (freshly truncated)
+        # results.jsonl exists with no nonzero rc — a session that died
+        # before writing results must never read as success
+        if [ "$SESS_RC" -eq 0 ] && [ -f "$OUT/results.jsonl" ] \
+            && ! grep -q '"rc": -\?[1-9]' "$OUT/results.jsonl"; then
+            echo "[watch] session fully green at $(date -u +%H:%M:%S)"
+            exit 0
+        fi
+        if [ "$ATTEMPTS" -ge "$MAX_ATTEMPTS" ]; then
+            echo "[watch] attempt cap reached with failed stages — stopping"
+            exit 1
+        fi
+        echo "[watch] session had failed/skipped stages — resuming watch"
     fi
     sleep "$PERIOD"
 done
