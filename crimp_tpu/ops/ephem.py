@@ -16,6 +16,7 @@ import numpy as np
 
 from crimp_tpu.models import timing
 from crimp_tpu.models.timing import N_FREQ_TERMS, TimingParams
+from crimp_tpu.ops import fasttrig
 from crimp_tpu.ops.fold import SECONDS_PER_DAY, phase_no_waves
 
 from math import factorial
@@ -84,7 +85,12 @@ def integer_rotation(tm: TimingParams, time_mjd: jax.Array, tol_phase: float = 1
         "freq_intRotation": freq,
         "freqdot_intRotation": fdot,
         "ph_intRotation": ph,
-        "phase_residual_from_integer": ph - jnp.round(ph),
+        # centered_frac, not jnp.round: this stack's round lowering is
+        # off-by-one near half-integers at large magnitude (see
+        # fasttrig.centered_frac); the residual here is near 0 so the
+        # bug window is unreachable in practice, but the safe reduction
+        # costs the same.
+        "phase_residual_from_integer": fasttrig.centered_frac(ph),
     }
 
 

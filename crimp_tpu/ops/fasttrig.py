@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import os
 
+import jax.numpy as jnp
+
 # Least-squares fits on [-0.5, 0.5] (degree 11 odd / 12 even in x; fit and
 # error bounds reproduced by tests/test_search.py::TestPolyTrig).
 _SIN_COEFFS = (
@@ -80,6 +82,38 @@ def poly_trig_enabled(override: bool | None = None) -> bool:
     import jax
 
     return jax.default_backend() == "tpu"
+
+
+def centered_frac(x):
+    """x minus its nearest integer via floor — exactly in [-0.5, 0.5).
+
+    Deliberately NOT ``x - jnp.round(x)``: the axon TPU path's f64
+    emulation mis-lowers round, returning off-by-one results for
+    arguments near a half-integer at large magnitude — measured on-chip:
+    ``jnp.round(1215782.499995642) -> 1215781.0``, with a bad window
+    that grows with magnitude (~|x| * 2^-31, i.e. an f32 intermediate);
+    the true-CPU lowering is correct, so only on-chip runs were wrong
+    and only a tier test can guard it (tests/test_tpu_tier.py). The
+    mis-round leaves |frac| up to 1.5. Hardware trig forgives an integer offset
+    (cos 2pi(x-n) = cos 2pi x for any integer n), which is why the bug
+    stayed invisible; the range-limited polynomial pair does not, and the
+    Chebyshev harmonic recurrence amplifies |cos1| > 1 exponentially in
+    harmonic order — the round-4 on-chip 1e8-event H-test (nharm 20)
+    returned all-NaN through exactly this hole.
+
+    ``jnp.floor`` is verified correct on the same values. For |x| >= 1
+    (and any x in [0, 1)) both steps are exact in floating point for
+    |x| < 2^52: x - floor(x) subtracts values within a factor of 2
+    (Sterbenz), and the half-centering subtracts 1 from a value in
+    [0.5, 1). The one inexact window is x in (-0.5, 0), where
+    x - floor(x) = x + 1 rounds: the result can differ from x by up to
+    half an ulp of 1.0 (~1.1e-16 cycles in f64) — far below every
+    consumer's tolerance, but NOT bit-exact (the old round-based
+    reduction returned tiny negative x unchanged). Works for f32 and
+    f64 alike.
+    """
+    f = x - jnp.floor(x)
+    return f - (f >= 0.5).astype(f.dtype)
 
 
 def sincos_cycles(frac):

@@ -54,7 +54,7 @@ def _make_kernel(nharm: int, trial_tile: int):
         # Mosaic's iota is integer-only; cast after
         j_lo = jax.lax.broadcasted_iota(jnp.int32, (trial_tile, 1), 0).astype(jnp.float32)
         phase = cb[None, :] + j_lo * b[None, :]  # (T, EV)
-        frac = phase - jnp.round(phase)
+        frac = fasttrig.centered_frac(phase)
         sin1, cos1 = fasttrig.sincos_cycles(frac)
         c_sums, s_sums = chebyshev_weighted_sums(cos1, sin1, w[None, :], nharm)  # (nharm, T)
 
@@ -160,7 +160,7 @@ def z2_power_2d_grid_pallas(
     t_pad = jnp.pad(t64, (0, n_pad - n))
     w = jnp.pad(jnp.ones(n, jnp.float32), (0, n_pad - n))[None, :]
     b64 = df * t_pad
-    b = (b64 - jnp.round(b64)).astype(jnp.float32)[None, :]
+    b = fasttrig.centered_frac(b64).astype(jnp.float32)[None, :]
     quads = [(0.5 * fd) * t_pad**2 for fd in fd_arr]  # f64, trial-independent
 
     n_tiles = -(-n_freq // trial_tile)
@@ -172,7 +172,7 @@ def z2_power_2d_grid_pallas(
         freq64 = jnp.asarray(f_tiles)[:, None] * t_pad[None, :]
         for i, quad in enumerate(quads):
             base64 = freq64 + quad[None, :]
-            base = (base64 - jnp.round(base64)).astype(jnp.float32)
+            base = fasttrig.centered_frac(base64).astype(jnp.float32)
             c, s = _tile_chunk_sums(
                 base, b, w, nharm, trial_tile, event_chunk, interpret
             )

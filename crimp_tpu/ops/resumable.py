@@ -41,7 +41,13 @@ def _fingerprint(times: np.ndarray, freqs: np.ndarray, fdots: np.ndarray,
                  nharm: int, chunk_trials: int) -> dict:
     t = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
     return {
-        "version": 1,
+        # version is the KERNEL-SEMANTICS version: bump it whenever the
+        # statistic computed per chunk changes meaning/precision, so chunks
+        # from the old kernel can never mix into a post-fix result. v2:
+        # floor-based centered_frac phase reduction (the v1 round-based
+        # reduction fed out-of-range arguments to the poly-trig path —
+        # r4's all-NaN on-chip config-5).
+        "version": 2,
         "n_events": int(t.shape[0]),
         "events_sha256": hashlib.sha256(t.tobytes()).hexdigest(),
         "n_freq": int(len(freqs)),

@@ -133,7 +133,7 @@ def _harmonic_sums_cycles(
     polynomial pair on the already-reduced argument (ops/fasttrig.py).
     Returns f64 arrays of shape (nharm, ...).
     """
-    frac = phase_cycles - jnp.round(phase_cycles)
+    frac = fasttrig.centered_frac(phase_cycles)
     w = weights.astype(trig_dtype)
     if poly:
         sin1, cos1 = fasttrig.sincos_cycles(frac.astype(trig_dtype))
@@ -322,7 +322,7 @@ def harmonic_sums_uniform(
     # the f32 frac extraction keeps ~1e-5-cycle accuracy even for coarse
     # grids (fine ToA-search grids sit orders below that).
     b_raw = df * time_blocks
-    b_blocks = (b_raw - jnp.round(b_raw)).astype(jnp.float32)
+    b_blocks = fasttrig.centered_frac(b_raw).astype(jnp.float32)
 
     def one_tile(tile_idx):
         f_tile = f0 + (tile_idx * trial_block) * df  # f64 scalar
@@ -332,7 +332,7 @@ def harmonic_sums_uniform(
             # f64: one row per tile; the fdot term rides the same row (it is
             # frequency-independent, so the j_lo sweep is untouched by it)
             base = f_tile * t_blk + (0.5 * fdot) * t_blk**2
-            cb = (base - jnp.round(base)).astype(jnp.float32)
+            cb = fasttrig.centered_frac(base).astype(jnp.float32)
             phase32 = cb[None, :] + j_lo[:, None] * b_blk[None, :]
             c, s = _harmonic_sums_cycles(
                 phase32, w_blk[None, :].astype(jnp.float32), nharm, jnp.float32, poly

@@ -140,12 +140,13 @@ else
     stage tune_toafit 3600 python scripts/tune_toafit.py
 
     # 4) opportunistic TPU test tier (C_trig micro, hw/poly/Pallas A/B,
-    #    full-res ToA batch, MCMC fold precision, fast-path-vs-f64 bound)
-    # FIVE subprocess tests: 4 x 900 s + the A/B's 1800 s = 5400 s worst
-    # case; 6000 s leaves 600 s margin and only guards a pytest-level
+    #    full-res ToA batch, MCMC fold precision, fast-path-vs-f64 bound,
+    #    round-lowering/poly-H regression)
+    # SIX subprocess tests: 5 x 900 s + the A/B's 1800 s = 6300 s worst
+    # case; 7200 s leaves 900 s margin and only guards a pytest-level
     # hang beyond the subprocess timeouts. Re-audit this sum when adding
     # a tier test.
-    stage tpu_tier 6000 env CRIMP_TPU_RUN_TPU_TESTS=1 python -m pytest tests/test_tpu_tier.py -m tpu -q -s
+    stage tpu_tier 7200 env CRIMP_TPU_RUN_TPU_TESTS=1 python -m pytest tests/test_tpu_tier.py -m tpu -q -s
 
     # 5) block-size sweep for the poly-trig fast path + Pallas tile knobs
     #    (VERDICT r3 item 6: the 2^15/512 defaults predate poly trig);

@@ -78,6 +78,40 @@ def synth_events(n_events: int, span_s: float, pulsed_frac: float, seed: int,
     return np.sort(t)
 
 
+def open_scan(*args, store: str, **kwargs):
+    """ResumableScan, archiving a stale store instead of dying on it.
+
+    A fingerprint mismatch means the store's chunks were computed by a
+    different problem OR an older kernel version (resumable.py bumps the
+    manifest version on semantics changes). For this demonstration driver
+    the right move is to keep the stale chunks for the record and recompute
+    fresh — a watcher relaunch must converge on the fixed kernel, not loop
+    forever refusing the old store.
+    """
+    from crimp_tpu.ops.resumable import ResumableScan
+
+    try:
+        return ResumableScan(*args, store=store, **kwargs)
+    except ValueError as e:
+        if "fingerprint mismatch" not in str(e):
+            raise
+        archive_store(store)
+        return ResumableScan(*args, store=store, **kwargs)
+
+
+def archive_store(store: str) -> None:
+    """Move a checkpoint store aside (kept for the record) so the next run
+    recomputes from scratch."""
+    stale = pathlib.Path(store)
+    if not stale.exists():
+        return
+    n = 0
+    while (dest := stale.with_name(f"{stale.name}.stale{n}")).exists():
+        n += 1
+    stale.rename(dest)
+    log(f"[scale_configs] archived stale checkpoint store to {dest}")
+
+
 def config3(scale: float, checkpoint: str | None = None) -> dict:
     """1e7-event magnetar, 2-D (nu, nudot) Z^2, 1e6 trials."""
     from crimp_tpu.ops import search
@@ -101,11 +135,9 @@ def config3(scale: float, checkpoint: str | None = None) -> dict:
         # wedge-tolerant path: per-trial-chunk checkpoints, resume skips
         # completed chunks (so the measured wall reflects remaining work —
         # resumed_chunks in the output flags a partially-resumed wall)
-        from crimp_tpu.ops.resumable import ResumableScan
-
         # chunk_trials must be well under n_freq (25k at full scale) or the
         # whole scan is one chunk and a wedge still loses everything
-        scan = ResumableScan(
+        scan = open_scan(
             times - times.mean(), freqs, nharm=2, fdots=-(10.0 ** log_fdots),
             store=checkpoint, chunk_trials=2_500,
         )
@@ -160,9 +192,7 @@ def config5(scale: float, checkpoint: str | None = None) -> dict:
     t0 = time.perf_counter()
     extra = {}
     if checkpoint:
-        from crimp_tpu.ops.resumable import ResumableScan
-
-        scan = ResumableScan(
+        scan = open_scan(
             times - times.mean(), freqs, nharm=20, statistic="h",
             store=checkpoint, chunk_trials=5_000,
         )
@@ -211,10 +241,35 @@ def main():
     log(f"[scale_configs] devices: {jax.devices()}")
     ckpt = lambda name: (str(pathlib.Path(args.checkpoint) / name)
                          if args.checkpoint else None)
+    results = []
     if args.config in ("3", "all"):
-        print(json.dumps(config3(args.scale, checkpoint=ckpt("config3"))), flush=True)
+        results.append(config3(args.scale, checkpoint=ckpt("config3")))
+        print(json.dumps(results[-1]), flush=True)
     if args.config in ("5", "all"):
-        print(json.dumps(config5(args.scale, checkpoint=ckpt("config5"))), flush=True)
+        results.append(config5(args.scale, checkpoint=ckpt("config5")))
+        print(json.dumps(results[-1]), flush=True)
+    # A demonstration run that produced a wrong answer must not exit green:
+    # r4's on-chip config-5 returned an all-NaN power array (a broken
+    # round lowering reached through the poly-trig path) with rc=0, and
+    # the session recorded the stage as a success. NaN anywhere in the
+    # peak, or a missed injection, is a failure.
+    rc = 0
+    for r in results:
+        peak_key = "peak_z2" if "peak_z2" in r else "peak_H"
+        if not np.isfinite(r[peak_key]):
+            log(f"[scale_configs] FAIL config {r['config']}: {peak_key} is not finite")
+            rc = max(rc, 1)
+        elif not r["recovers_injection"]:
+            log(f"[scale_configs] FAIL config {r['config']}: injection not recovered")
+            rc = max(rc, 2)
+        else:
+            continue
+        # a failing run must not leave its chunks behind as same-fingerprint
+        # "done" work: a watcher relaunch would resume them verbatim and
+        # fail identically forever — archive so the relaunch recomputes
+        if args.checkpoint:
+            archive_store(ckpt(f"config{r['config']}"))
+    sys.exit(rc)
 
 
 if __name__ == "__main__":

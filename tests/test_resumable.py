@@ -112,6 +112,25 @@ class TestResumableScan:
             ResumableScan(events, freqs, nharm=2, store=str(store),
                           chunk_trials=200)
 
+    def test_store_refuses_older_kernel_version(self, events, tmp_path):
+        """Chunks from an older kernel-semantics version must be refused on
+        resume: r4's on-chip config-5 store held all-NaN chunks from the
+        v1 round-based phase reduction, and a relaunch must not reuse them
+        (resumable.py bumps the manifest version on semantics changes)."""
+        import json
+
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        ResumableScan(events, freqs, nharm=2, store=str(store),
+                      chunk_trials=200).run()
+        manifest = store / "manifest.json"
+        fp = json.loads(manifest.read_text())
+        fp["version"] = 1
+        manifest.write_text(json.dumps(fp))
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, store=str(store),
+                          chunk_trials=200)
+
     def test_atomic_chunks_ignore_tmp_leftovers(self, events, tmp_path):
         """A crash mid-write leaves only a .tmp file; resume must treat the
         chunk as missing rather than loading a torn artifact."""

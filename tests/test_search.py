@@ -209,6 +209,54 @@ class TestPolyTrig:
         assert np.max(np.abs(np.asarray(s) - np.sin(2 * np.pi * x))) < 3.2e-7
         assert np.max(np.abs(np.asarray(c) - np.cos(2 * np.pi * x))) < 4.0e-8
 
+    def test_centered_frac_round_bug_values(self):
+        """The floor-based reduction must stay in [-0.5, 0.5] on the values
+        the axon TPU path's round lowering mis-rounds (off-by-one near
+        half-integers at ~1e6 magnitude: jnp.round(1215782.499995642) ->
+        1215781.0 on-chip; true CPU rounds correctly, so the on-chip tier
+        carries the platform-level guard) and must equal the exact
+        numpy reduction."""
+        import jax.numpy as jnp
+
+        from crimp_tpu.ops import fasttrig
+
+        x0 = 1215782.499995642
+        f0 = float(fasttrig.centered_frac(jnp.float64(x0)))
+        assert abs(f0) <= 0.5
+        assert f0 == pytest.approx(0.499995642, abs=1e-9)
+        # adversarial sweep: both sides of half-integers at large magnitude,
+        # spanning the bad window (~|x| * 2^-31) and exact halves
+        n = 1215782.0
+        eps = np.array([0.0, 1e-9, 1e-7, 4.357e-6, 1e-5, 1e-4, 1e-3, 0.4])
+        xs = np.concatenate([s * (n + 0.5 - d * eps)
+                             for s in (1.0, -1.0) for d in (1.0, -1.0)])
+        fr = np.asarray(fasttrig.centered_frac(jnp.asarray(xs)))
+        assert np.all(np.abs(fr) <= 0.5)
+        # exact match with the same reduction done in numpy (floor is
+        # correct in both; the subtraction is exact per Sterbenz)
+        ref = xs - np.floor(xs)
+        ref -= (ref >= 0.5)
+        np.testing.assert_array_equal(fr, ref)
+
+    def test_htest_poly_large_phase_magnitude(self, monkeypatch):
+        """Round-lowering regression (r4 on-chip config-5 all-NaN): at
+        ~1.4e6-cycle phase magnitudes the axon TPU round lowering leaves
+        |frac| up to 1.5, the polynomial pair explodes on the out-of-range
+        argument, and the nharm-20 Chebyshev recurrence amplifies it to
+        inf/NaN. This CPU run pins the shape/accuracy contract at those
+        magnitudes; the on-chip tier repeats it on the platform where the
+        buggy lowering lives."""
+        import jax.numpy as jnp
+
+        monkeypatch.setenv("CRIMP_TPU_SHARD", "0")
+        rng = np.random.RandomState(0)
+        t = jnp.asarray(np.sort(rng.uniform(-1e7, 1e7, 20_000)))
+        freqs = jnp.asarray(0.1432 + 2.5e-8 * (np.arange(256) - 128))
+        hw = np.asarray(search.h_power(t, freqs, 20, poly=False))
+        poly = np.asarray(search.h_power(t, freqs, 20, poly=True))
+        assert np.isfinite(poly).all()
+        np.testing.assert_allclose(poly, hw, rtol=2e-3, atol=0.5)
+
     def test_env_and_override_resolution(self, monkeypatch):
         import jax
 

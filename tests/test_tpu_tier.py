@@ -89,6 +89,53 @@ def run_on_chip(body: str, timeout: float = 900.0) -> dict:
     return json.loads(out.stdout.strip().splitlines()[-1])
 
 
+class TestOnChipRoundLowering:
+    def test_h_poly_high_nharm_large_phase(self):
+        """r4 on-chip config-5 regression (all-NaN H array): the axon f64
+        round lowering is off-by-one near half-integers at large magnitude
+        (measured: round(1215782.499995642) -> 1215781 on-chip, correct on
+        true CPU), which fed |frac| up to 1.5 into the range-limited poly
+        pair and the nharm-20 Chebyshev recurrence amplified it to NaN.
+        The kernels' floor-based reduction (fasttrig.centered_frac) must
+        keep the poly H-test finite and in agreement with hardware trig at
+        exactly those magnitudes, on the platform where the buggy lowering
+        lives. CPU twin: test_search.py::test_htest_poly_large_phase_magnitude."""
+        result = run_on_chip(
+            """
+            import json
+            import numpy as np
+            import jax.numpy as jnp
+            from crimp_tpu.ops import fasttrig, search
+
+            # record the platform's round behavior on the adversarial value
+            # (diagnostic only: the kernels must be correct either way)
+            bad = float(jnp.round(jnp.float64(1215782.499995642)))
+            cf = float(fasttrig.centered_frac(jnp.float64(1215782.499995642)))
+            rng = np.random.RandomState(0)
+            t = jnp.asarray(np.sort(rng.uniform(-1e7, 1e7, 100_000)))
+            freqs = jnp.asarray(0.1432 + 2.5e-8 * (np.arange(512) - 256))
+            hw = np.asarray(search.h_power(t, freqs, 20, poly=False))
+            po = np.asarray(search.h_power(t, freqs, 20, poly=True))
+            print(json.dumps({
+                "platform_round_of_1215782_4999956": bad,
+                "centered_frac": cf,
+                "hw_finite": bool(np.isfinite(hw).all()),
+                "poly_finite": bool(np.isfinite(po).all()),
+                "max_rel_dev": float(np.max(np.abs(po - hw) / (np.abs(hw) + 1.0))),
+            }))
+            """
+        )
+        assert abs(result["centered_frac"]) <= 0.5
+        assert result["hw_finite"]
+        assert result["poly_finite"], (
+            "poly-trig H-test NaN'd on-chip: the phase reduction is feeding "
+            "out-of-range arguments to the polynomial pair again"
+        )
+        assert result["max_rel_dev"] < 2e-2
+        print(f"tier round lowering: round(...)={result['platform_round_of_1215782_4999956']}, "
+              f"poly/hw max rel dev {result['max_rel_dev']:.2e}")
+
+
 class TestOnChipToABatch:
     def test_84_segments_full_resolution(self):
         """The headline shape (84 segments, ph_shift_res=1000) must fit,
