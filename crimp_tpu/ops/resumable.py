@@ -46,8 +46,10 @@ def _fingerprint(times: np.ndarray, freqs: np.ndarray, fdots: np.ndarray,
         # from the old kernel can never mix into a post-fix result. v2:
         # floor-based centered_frac phase reduction (the v1 round-based
         # reduction fed out-of-range arguments to the poly-trig path —
-        # r4's all-NaN on-chip config-5).
-        "version": 2,
+        # r4's all-NaN on-chip config-5). v3: shared-row 2-D kernel
+        # (harmonic_sums_uniform_2d) — ~2-ulp f32 combine difference per
+        # phase vs the per-fdot v2 path.
+        "version": 3,
         "n_events": int(t.shape[0]),
         "events_sha256": hashlib.sha256(t.tobytes()).hexdigest(),
         "n_freq": int(len(freqs)),

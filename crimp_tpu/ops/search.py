@@ -393,6 +393,78 @@ def h_power_grid(
 
 
 @partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block", "trial_block", "poly"))
+def harmonic_sums_uniform_2d(
+    times: jax.Array,
+    f0: float,
+    df: float,
+    n_freq: int,
+    fdots: jax.Array,
+    nharm: int,
+    event_block: int = GRID_EVENT_BLOCK,
+    trial_block: int = GRID_TRIAL_BLOCK,
+    weights: jax.Array | None = None,
+    poly: bool = False,
+):
+    """Trig sums over the (fdot x uniform-frequency) grid, sharing the f64
+    rows across BOTH grid axes -> (n_fdot, nharm, n_freq) each.
+
+    The phase at (fdot_i, trial j = j0 + j_lo) splits into three terms:
+
+        f_j*t + fd_i*t^2/2 = [f_tile*t] + [fd_i*t^2/2] + j_lo*(df*t)
+
+    The first bracket depends only on the TILE (one f64 row each), the
+    second only on the FDOT (one f64 row each) — so the f64-emulated work
+    per event block is (n_tiles + n_fdot) rows instead of the
+    n_tiles*n_fdot rows paid when each fdot re-runs the 1-D fast path
+    (the round-4 full-scale config 3 measured at 43% of the 1-D rate for
+    exactly this reason). Each reduced term lies in [-0.5, 0.5), their
+    f32 sum adds ~2 ulp (~1.2e-7 cycles) to the fast path's error budget
+    (bounded by trial_block/2 * 2^-24 ~ 1.5e-5 cycles), and
+    _harmonic_sums_cycles re-reduces before trig.
+    """
+    time_blocks, weight_blocks = _block_times(times, event_block, weights)
+    n_tiles = -(-n_freq // trial_block)
+    j_lo = jnp.arange(trial_block, dtype=jnp.float32)
+    b_blocks = fasttrig.centered_frac(df * time_blocks).astype(jnp.float32)
+    f_tiles = f0 + (jnp.arange(n_tiles, dtype=jnp.float64) * trial_block) * df
+    fd = jnp.asarray(fdots, dtype=jnp.float64)
+    n_fdot = fd.shape[0]
+    if n_fdot == 0:  # static at trace time; empty grid -> empty result
+        empty = jnp.zeros((0, nharm, n_freq), jnp.float64)
+        return empty, empty
+
+    # Anchor the carry to the traced operands (shard_map varying axes).
+    anchor = 0.0 * (time_blocks[0, 0] + f_tiles[0] + jnp.sum(fd))
+    zeros = jnp.zeros((n_fdot, n_tiles, nharm, trial_block), jnp.float64) + anchor
+
+    def step(carry, blk):
+        t_blk, w_blk, b_blk = blk
+        row_t = fasttrig.centered_frac(
+            f_tiles[:, None] * t_blk[None, :]).astype(jnp.float32)       # (n_tiles, EB)
+        row_q = fasttrig.centered_frac(
+            (0.5 * fd)[:, None] * (t_blk * t_blk)[None, :]).astype(jnp.float32)  # (n_fdot, EB)
+        w32 = w_blk.astype(jnp.float32)
+
+        def per_fdot(q_row):
+            def per_tile(t_row):
+                phase32 = (t_row + q_row)[None, :] + j_lo[:, None] * b_blk[None, :]
+                return _harmonic_sums_cycles(
+                    phase32, w32[None, :], nharm, jnp.float32, poly
+                )
+            return jax.lax.map(per_tile, row_t)      # (n_tiles, nharm, TB) x2
+
+        c, s = jax.lax.map(per_fdot, row_q)          # (n_fdot, n_tiles, nharm, TB) x2
+        return (carry[0] + c, carry[1] + s), None
+
+    (c_sum, s_sum), _ = jax.lax.scan(
+        step, (zeros, zeros), (time_blocks, weight_blocks, b_blocks)
+    )
+    c_all = jnp.moveaxis(c_sum, 2, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
+    s_all = jnp.moveaxis(s_sum, 2, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
+    return c_all, s_all
+
+
+@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block", "trial_block", "poly"))
 def z2_power_2d_grid(
     times: jax.Array,
     f0: float,
@@ -406,21 +478,16 @@ def z2_power_2d_grid(
 ) -> jax.Array:
     """Z^2_n over the (fdot x uniform-frequency) grid -> (n_fdot, n_freq).
 
-    Each fdot reuses the uniform-grid fast path with the quadratic term
-    folded into the per-tile f64 row (it is frequency-independent), so the
-    2-D scan inherits the same (trial_block-1)/trial_block f64 saving.
-    ``fdots`` are SIGNED Hz/s as in z2_power_2d.
+    Built on harmonic_sums_uniform_2d: the per-tile f64 frequency rows are
+    shared across fdots and the per-fdot f64 quadratic rows are shared
+    across tiles. ``fdots`` are SIGNED Hz/s as in z2_power_2d.
     """
     n = times.shape[0]
-
-    def one_fdot(fd):
-        c, s = harmonic_sums_uniform(
-            times, f0, df, n_freq, nharm, event_block, trial_block, fdot=fd,
-            poly=poly,
-        )
-        return jnp.sum(z2_from_sums(c, s, n), axis=0)
-
-    return jax.lax.map(one_fdot, jnp.asarray(fdots, dtype=jnp.float64))
+    c, s = harmonic_sums_uniform_2d(
+        times, f0, df, n_freq, jnp.asarray(fdots, dtype=jnp.float64), nharm,
+        event_block, trial_block, poly=poly,
+    )
+    return jnp.sum(z2_from_sums(c, s, n), axis=1)
 
 
 @partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype", "poly"))

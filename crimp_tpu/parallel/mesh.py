@@ -58,7 +58,7 @@ from crimp_tpu.ops.search import (
     GRID_TRIAL_BLOCK,
     _blocked_trial_sums,
     grid_fastpath_enabled,
-    harmonic_sums_uniform,
+    harmonic_sums_uniform_2d,
     uniform_grid,
     z2_from_sums,
 )
@@ -209,14 +209,13 @@ def _sharded_sums_grid(
     def kernel(t_shard, w_shard, fd_all):
         tile = jax.lax.axis_index(TRIAL_AXIS)
         f0_shard = f0 + (tile * n_freq_shard) * df
-
-        def one_fd(fd):
-            return harmonic_sums_uniform(
-                t_shard, f0_shard, df, n_freq_shard, nharm,
-                event_block, trial_block, fdot=fd, weights=w_shard, poly=poly,
-            )
-
-        c_all, s_all = jax.lax.map(one_fd, fd_all)
+        # shared-row 2-D kernel: per-tile f64 frequency rows shared across
+        # fdots, per-fdot quadratic rows shared across tiles (same win as
+        # the single-device path; see harmonic_sums_uniform_2d)
+        c_all, s_all = harmonic_sums_uniform_2d(
+            t_shard, f0_shard, df, n_freq_shard, fd_all, nharm,
+            event_block, trial_block, weights=w_shard, poly=poly,
+        )
         return jax.lax.psum(c_all, EVENT_AXIS), jax.lax.psum(s_all, EVENT_AXIS)
 
     return shard_map(
