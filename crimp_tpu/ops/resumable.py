@@ -93,6 +93,7 @@ class ResumableScan:
         # fingerprint: chunks computed under different trig/precision modes
         # (poly flipped between runs, fast path toggled, blocks re-tuned)
         # must never silently mix into one power array.
+        self._poly_explicit = poly is not None
         self.poly = fasttrig.poly_trig_enabled(poly)
         self._fastpath = (search.uniform_grid(self.freqs) is not None
                           and search.grid_fastpath_enabled(self.nharm))
@@ -117,11 +118,36 @@ class ResumableScan:
         if manifest.exists():
             existing = json.loads(manifest.read_text())
             if existing != fp:
-                raise ValueError(
-                    f"checkpoint store {self.store} belongs to a different "
-                    "problem (manifest fingerprint mismatch); refusing to mix "
-                    "chunks — use a fresh store directory"
+                # Same problem + same kernel version, but the poly-trig /
+                # fast-path PREFERENCES resolved differently (an env knob
+                # or an auto threshold changed between sessions): adopt the
+                # store's pinned modes so completed chunks stay usable —
+                # the result is coherent under the store's mode, which is
+                # what "resume" means. Anything else (different problem,
+                # different kernel version, different block tiling — the
+                # blocks are module constants this instance cannot adopt)
+                # still refuses.
+                mode = existing.get("numeric_mode", {})
+                adoptable = (
+                    {k: v for k, v in existing.items() if k != "numeric_mode"}
+                    == {k: v for k, v in fp.items() if k != "numeric_mode"}
+                    and mode.get("grid_blocks") == self._numeric_mode["grid_blocks"]
+                    # an EXPLICIT constructor poly= that conflicts with the
+                    # store's pinned mode is a real mismatch, not a
+                    # preference drift — silently adopting would hand a
+                    # poly-validation run hw-trig chunks (or vice versa)
+                    and not (self._poly_explicit
+                             and bool(mode.get("poly_trig")) != self.poly)
                 )
+                if not adoptable:
+                    raise ValueError(
+                        f"checkpoint store {self.store} belongs to a different "
+                        "problem (manifest fingerprint mismatch); refusing to mix "
+                        "chunks — use a fresh store directory"
+                    )
+                self.poly = bool(mode["poly_trig"])
+                self._fastpath = bool(mode["grid_fastpath"])
+                self._numeric_mode = mode
         else:
             self.store.mkdir(parents=True, exist_ok=True)
             tmp = manifest.with_suffix(".json.tmp")

@@ -80,11 +80,16 @@ def _env_blocks(default_event: int, default_trial: int) -> tuple[int, int]:
 # CRIMP_TPU_GRID_BLOCKS while the post-poly-trig sweep is pending.
 GRID_EVENT_BLOCK, GRID_TRIAL_BLOCK = _env_blocks(1 << 15, 512)
 # The fast path's f32 inner sweep carries phase error up to
-# trial_block/2 * 2^-24 cycles, which the Chebyshev recurrence amplifies
-# ~linearly in harmonic number; past this order the error budget is no
-# longer orders below the statistic's sqrt(N) noise, so auto mode falls
-# back to the exact-f64-phase general kernel.
-GRID_FASTPATH_MAX_NHARM = 8
+# trial_block/2 * 2^-24 ~ 1.5e-5 cycles, which the Chebyshev recurrence
+# amplifies ~linearly in harmonic number. Against the statistic's own
+# noise the relative error is ~2.6*k*u independent of N (random-walk over
+# events), i.e. ~8e-4 of the noise scale at k=20 — and measured directly:
+# max |dH| = 7.8e-4 (1.2e-4 of sqrt-noise) at nharm=20 over a +-1e7 s
+# baseline, identical argmax (r4, CPU, poly on and off). 20 is the
+# reference's blind-search maximum (periodsearch.py htest default), so
+# every product workload now takes the f64-lean path; beyond that, auto
+# mode falls back to the exact-f64-phase general kernel.
+GRID_FASTPATH_MAX_NHARM = 20
 # Below this many (trial, event) pairs the dispatch/collective overhead of
 # auto-sharding outweighs the parallel win (PeriodSearch._mesh).
 MIN_SHARD_PAIRS = 1 << 22

@@ -99,15 +99,39 @@ class TestResumableScan:
         sharded = ResumableScan(events, freqs, nharm=2, chunk_trials=200).run()
         np.testing.assert_allclose(sharded, single, rtol=1e-4, atol=1e-3)
 
-    def test_store_refuses_numeric_mode_change(self, events, tmp_path, monkeypatch):
-        """Chunks computed under different trig modes must never mix: a
-        store written with poly trig off refuses a resume with it forced on."""
+    def test_store_adopts_pinned_trig_mode(self, events, tmp_path, monkeypatch):
+        """Chunks computed under different trig modes must never mix — but a
+        store whose only difference is a poly/fast-path PREFERENCE adopts
+        the store's pinned mode on resume (completed chunks stay usable;
+        the assembled result is coherent under the pinned mode)."""
         freqs = np.linspace(0.1428, 0.1436, 400)
         store = tmp_path / "ckpt"
         monkeypatch.delenv("CRIMP_TPU_POLY_TRIG", raising=False)
+        first = ResumableScan(events, freqs, nharm=2, store=str(store),
+                              chunk_trials=200)
+        power = first.run()
+        # drop one chunk so the resume actually COMPUTES under the adopted
+        # mode (a fully-cached store would make the equality trivial)
+        dropped = sorted(store.glob("chunk_*.npy"))[1]
+        dropped.unlink()
+        monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "1")
+        resumed = ResumableScan(events, freqs, nharm=2, store=str(store),
+                                chunk_trials=200)
+        assert resumed.poly == first.poly  # adopted, not the env's value
+        np.testing.assert_array_equal(resumed.run(), power)
+        # an EXPLICIT conflicting poly= still refuses
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, store=str(store),
+                          chunk_trials=200, poly=True)
+
+    def test_store_refuses_block_tiling_change(self, events, tmp_path, monkeypatch):
+        """Block tiling is a module constant this instance cannot adopt —
+        a store written under different grid blocks still refuses."""
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
         ResumableScan(events, freqs, nharm=2, store=str(store),
                       chunk_trials=200).run()
-        monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "1")
+        monkeypatch.setattr(search, "GRID_EVENT_BLOCK", 1024)
         with pytest.raises(ValueError, match="fingerprint mismatch"):
             ResumableScan(events, freqs, nharm=2, store=str(store),
                           chunk_trials=200)
