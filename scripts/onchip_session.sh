@@ -123,7 +123,9 @@ if [ "$DRY" = "1" ]; then
     stage config3 900 python scripts/run_scale_configs.py --config 3 --scale 0.002 --cpu
     stage config5 900 python scripts/run_scale_configs.py --config 5 --scale 0.001 --cpu
     stage tune_toafit 1200 python scripts/tune_toafit.py --events 500 --segments 4 --res 100 --repeat 1 --cpu
-    stage tpu_tier 2400 env CRIMP_TPU_RUN_TPU_TESTS=1 CRIMP_TPU_TIER_FORCE_CPU=1 \
+    # 3600 s: six tier bodies at CPU speed (the A/B alone runs minutes on
+    # CPU; r4's dry-run hit the old 2400 s cap at rc=124)
+    stage tpu_tier 3600 env CRIMP_TPU_RUN_TPU_TESTS=1 CRIMP_TPU_TIER_FORCE_CPU=1 \
         python -m pytest tests/test_tpu_tier.py -m tpu -q -s
     stage sweep_blocks 1800 python scripts/sweep_blocks.py --events 20000 --trials 2000 --cpu
 else
