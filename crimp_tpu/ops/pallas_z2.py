@@ -13,9 +13,10 @@ scan sequencing. This kernel owns both knobs explicitly:
 - harmonics use the same Chebyshev recurrence as the XLA kernels.
 
 Same decomposition as the XLA fast path: phase(j0 + j_lo, t) =
-frac(f_tile*t) + j_lo*frac(df*t), with the f64 part (one row per trial
-tile) precomputed OUTSIDE the kernel in chunks of ``tile_chunk`` tiles so
-HBM holds (tile_chunk x n_events) f32 rows, never the full grid.
+frac(f_tile*t) + frac(fd*t^2/2) + j_lo*frac(df*t), with the f64 parts
+(one row per trial tile + one per fdot — shared across the other axis)
+precomputed OUTSIDE the kernel in chunks of ``tile_chunk`` tiles so HBM
+holds (tile_chunk x n_events) f32 rows, never the full grid.
 
 Status: correctness is pinned against the XLA kernels in
 tests/test_search.py (interpret mode on CPU); the on-chip A/B against the
@@ -123,8 +124,9 @@ def z2_power_grid_pallas(
     Drop-in comparable to ops.search.z2_power_grid (same statistic, f32
     accumulation); ``interpret=True`` runs the kernel in the Pallas
     interpreter for CPU correctness tests. A nonzero ``fdot`` (signed
-    Hz/s) rides the per-tile f64 base row exactly as in the XLA fast path
-    (it is frequency-independent), so the kernel itself is untouched.
+    Hz/s) becomes its own f64-reduced, f32-cast row added to the per-tile
+    frequency row in f32 (the shared-row decomposition; frequency-
+    independent), so the kernel itself is untouched.
     """
     return z2_power_2d_grid_pallas(
         times, f0, df, n_freq, [fdot], nharm, trial_tile, event_chunk,
@@ -161,7 +163,15 @@ def z2_power_2d_grid_pallas(
     w = jnp.pad(jnp.ones(n, jnp.float32), (0, n_pad - n))[None, :]
     b64 = df * t_pad
     b = fasttrig.centered_frac(b64).astype(jnp.float32)[None, :]
-    quads = [(0.5 * fd) * t_pad**2 for fd in fd_arr]  # f64, trial-independent
+    # Shared-row decomposition (same as search.harmonic_sums_uniform_2d):
+    # the quadratic term is frequency-independent and the frequency row is
+    # fdot-independent, so each is reduced in f64 ONCE — per fdot and per
+    # tile chunk respectively — and combined in f32 (~2 ulp against the
+    # fast path's 1.5e-5-cycle budget; the kernel re-reduces before trig).
+    quad_rows = [
+        fasttrig.centered_frac((0.5 * fd) * t_pad**2).astype(jnp.float32)
+        for fd in fd_arr
+    ]
 
     n_tiles = -(-n_freq // trial_tile)
     c_parts = [[] for _ in fd_arr]
@@ -169,10 +179,10 @@ def z2_power_2d_grid_pallas(
     for chunk_start in range(0, n_tiles, tile_chunk):
         k = min(tile_chunk, n_tiles - chunk_start)
         f_tiles = f0 + (chunk_start + np.arange(k)) * (trial_tile * df)
-        freq64 = jnp.asarray(f_tiles)[:, None] * t_pad[None, :]
-        for i, quad in enumerate(quads):
-            base64 = freq64 + quad[None, :]
-            base = fasttrig.centered_frac(base64).astype(jnp.float32)
+        freq_rows = fasttrig.centered_frac(
+            jnp.asarray(f_tiles)[:, None] * t_pad[None, :]).astype(jnp.float32)
+        for i, qrow in enumerate(quad_rows):
+            base = freq_rows + qrow[None, :]  # pure f32
             c, s = _tile_chunk_sums(
                 base, b, w, nharm, trial_tile, event_chunk, interpret
             )
