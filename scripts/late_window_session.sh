@@ -1,0 +1,38 @@
+#!/bin/bash
+# Reduced late-window session: the three highest-value artifacts only
+# (~20 min), for a relay recovery too late for the full session — leaves
+# the chip free well before the round-end driver bench.
+#
+# Usage: bash scripts/late_window_session.sh [outdir]
+
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-onchip_results_r4}"
+mkdir -p "$OUT"
+RESULTS="$OUT/results_late.jsonl"
+: > "$RESULTS"
+
+run() {
+    local name="$1"; shift
+    local tmo="$1"; shift
+    echo "=== [late:$name] $(date -u +%H:%M:%S) ===" | tee -a "$OUT/session.log"
+    ( timeout "$tmo" "$@" ) > "$OUT/${name}_late.log" 2>&1
+    local rc=$?
+    echo "{\"stage\": \"$name\", \"rc\": $rc}" >> "$RESULTS"
+    echo "=== [late:$name] rc=$rc ===" | tee -a "$OUT/session.log"
+}
+
+# 1) config-5 full scale on the fixed kernel (the round's one open claim)
+run config5 1500 python scripts/run_scale_configs.py --config 5 --checkpoint "$OUT/ckpt"
+# 2) the round-lowering regression on the platform where the bug lives
+run round_guard 900 env CRIMP_TPU_RUN_TPU_TESTS=1 \
+    python -m pytest "tests/test_tpu_tier.py::TestOnChipRoundLowering" -q -s
+# 3) clean bench (uncontended z2 numbers; new 2-D kernel in the north star)
+run bench 2400 python bench.py
+# extract_rates reads $OUT/bench.log; promote the late log when green so
+# the ratchet sees the uncontended numbers (attempt 1's log is in git)
+grep -q '"stage": "bench", "rc": 0' "$RESULTS" && cp "$OUT/bench_late.log" "$OUT/bench.log"
+
+python scripts/extract_rates.py "$OUT" 2>&1 | tee -a "$OUT/session.log"
+echo "{\"stage\": \"extract_rates\", \"rc\": ${PIPESTATUS[0]}}" >> "$RESULTS"
+cat "$RESULTS"
