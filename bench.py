@@ -42,13 +42,38 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def choose_platform(timeout_s: float = 240.0) -> str:
-    """Probe the default JAX backend out-of-process; 'cpu' on failure.
+def relay_port_open(port: int, timeout_s: float = 5.0) -> bool:
+    """True when the accelerator relay accepts TCP connections.
 
-    The probe runs in a subprocess because a wedged relay HANGS inside
+    The cheapest possible health signal: no JAX process is spawned and no
+    single-client grant is touched, so polling it while the relay is down
+    costs nothing and can wedge nothing."""
+    import socket
+
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=timeout_s):
+            return True
+    except OSError:
+        return False
+
+
+def choose_platform(probe_timeout_s: float = 300.0) -> str:
+    """Acquire an accelerator backend, retrying until a deadline; 'cpu' only
+    after the deadline expires (VERDICT r4 #1: the round-end record must not
+    say "cpu" just because the relay was busy for eight minutes).
+
+    Each probe runs in a subprocess because a wedged relay HANGS inside
     backend init rather than raising — an in-process attempt would take the
-    bench down with it. One retry, then CPU fallback.
-    ``CRIMP_TPU_BENCH_PLATFORM`` or ``JAX_PLATFORMS=cpu`` skip the probe.
+    bench down with it. While the relay's TCP port refuses connections the
+    wait costs only a socket poll (no grant is touched; a timeout-killed
+    JAX probe can itself wedge the relay for up to ~1 h). A probe that
+    comes back "cpu" means the accelerator plugin fell back — that is a
+    failed acquisition, not a platform choice, so it retries too.
+
+    Knobs: ``CRIMP_TPU_BENCH_PLATFORM`` / ``JAX_PLATFORMS=cpu`` skip the
+    probe entirely; ``CRIMP_TPU_BENCH_PROBE_DEADLINE_S`` (default 3600 —
+    sized to ride out one stale-grant expiry) bounds the total wait;
+    ``CRIMP_TPU_RELAY_PORT`` (default 8113) locates the relay.
     """
     import os
 
@@ -57,21 +82,59 @@ def choose_platform(timeout_s: float = 240.0) -> str:
         return forced
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         return "cpu"
+    deadline_s = float(os.environ.get("CRIMP_TPU_BENCH_PROBE_DEADLINE_S", "3600"))
+    port = int(os.environ.get("CRIMP_TPU_RELAY_PORT", "8113"))
     probe = "import jax; print(jax.devices()[0].platform)"
-    for attempt in (1, 2):
+    deadline = time.monotonic() + deadline_s
+    attempt = 0
+    probed_with_port_closed = False
+    while True:
+        port_open = relay_port_open(port)
+        # Port-closed short-circuit: skip the expensive probe — but verify
+        # the assumption ONCE per bench (an accelerator path that does not
+        # go through a local relay must still be discoverable).
+        if not port_open and probed_with_port_closed:
+            if time.monotonic() >= deadline:
+                break
+            log(f"[bench] relay port {port} closed; polling "
+                f"({int(deadline - time.monotonic())}s to deadline)")
+            time.sleep(min(30.0, max(1.0, deadline - time.monotonic())))
+            continue
+        attempt += 1
         try:
             out = subprocess.run(
                 [sys.executable, "-c", probe],
-                timeout=timeout_s, capture_output=True, text=True,
+                timeout=probe_timeout_s, capture_output=True, text=True,
             )
             if out.returncode == 0 and out.stdout.strip():
-                return out.stdout.strip().splitlines()[-1]
-            log(f"[bench] backend probe attempt {attempt} failed "
-                f"(rc={out.returncode}): {out.stderr.strip()[-300:]}")
+                platform = out.stdout.strip().splitlines()[-1]
+                if platform != "cpu":
+                    return platform
+                if not port_open:
+                    # the plugin itself says cpu AND there is no relay to
+                    # wait for: a genuinely accelerator-less machine —
+                    # waiting out the deadline would be pure stall
+                    log("[bench] no relay and the backend is cpu — "
+                        "this is a CPU machine")
+                    return "cpu"
+                log(f"[bench] backend probe attempt {attempt}: accelerator "
+                    "plugin fell back to cpu — retrying")
+            else:
+                log(f"[bench] backend probe attempt {attempt} failed "
+                    f"(rc={out.returncode}): {out.stderr.strip()[-300:]}")
+            retry_wait = 60.0
         except subprocess.TimeoutExpired:
-            log(f"[bench] backend probe attempt {attempt} timed out after {timeout_s}s")
-        if attempt == 1:
-            time.sleep(3)
+            log(f"[bench] backend probe attempt {attempt} timed out "
+                f"after {probe_timeout_s}s (relay wedged?)")
+            # a timeout-killed probe can itself wedge the grant: re-probing
+            # at the normal cadence would kill-rewedge in a loop, so back
+            # off on the grant-expiry timescale instead
+            retry_wait = 600.0
+        probed_with_port_closed = not port_open
+        if time.monotonic() >= deadline:
+            break
+        time.sleep(min(retry_wait, max(1.0, deadline - time.monotonic())))
+    log(f"[bench] no accelerator within the {deadline_s:.0f}s probe deadline")
     return "cpu"
 
 
@@ -395,8 +458,40 @@ def bench_config4(template_path: str, n_segments: int = 500, events_per_seg: int
     }
 
 
+def emit_partial(name: str, payload: dict) -> None:
+    """Append one sub-measurement's result to the partial-artifact sidecar
+    (``CRIMP_TPU_BENCH_PARTIAL``, set by the session scripts) the moment it
+    completes — a later stage wedging the process must not erase earlier
+    measurements (VERDICT r4 #8). Best-effort: the sidecar failing must
+    never take down the bench."""
+    import os
+
+    path = os.environ.get("CRIMP_TPU_BENCH_PARTIAL", "").strip()
+    if not path:
+        return
+    try:
+        with open(path, "a") as fh:
+            fh.write(json.dumps({"stage": name, **payload}) + "\n")
+            fh.flush()
+    except Exception as exc:  # noqa: BLE001 - sidecar failure must not
+        # take down the bench (nor turn a SUCCESSFUL measurement into a
+        # recorded failure via step()'s handler)
+        log(f"[bench] partial sidecar write failed: {exc}")
+
+
 def main():
+    import os
     import pathlib
+    import traceback
+
+    # fresh sidecar per run: stale rows from an earlier attempt in the same
+    # outdir must never be stitched into this run's reconstruction
+    sidecar = os.environ.get("CRIMP_TPU_BENCH_PARTIAL", "").strip()
+    if sidecar:
+        try:
+            open(sidecar, "w").close()
+        except OSError as exc:
+            log(f"[bench] could not truncate partial sidecar: {exc}")
 
     platform = choose_platform()
     import jax
@@ -406,6 +501,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         log("[bench] accelerator unavailable -> running on CPU (tagged)")
     log(f"[bench] platform: {platform}")
+    emit_partial("platform", {"platform": platform})
 
     here = pathlib.Path(__file__).parent
     par = str(here / "tests/data/1e2259.par")
@@ -422,19 +518,47 @@ def main():
     ns_freq, ns_fdot = (250, 8) if on_cpu else (2500, 40)
     cfg4_segments, cfg4_events = (100, 1_000) if on_cpu else (500, 2_000)
 
+    errors: dict[str, str] = {}
+
+    def step(name: str, fn, *args, **kwargs):
+        """Run one sub-measurement; a failure records the error and moves
+        on so the final record carries every measurement that DID finish."""
+        try:
+            out = fn(*args, **kwargs)
+            emit_partial(name, out if isinstance(out, dict) else {"ok": True})
+            return out
+        except Exception as exc:  # noqa: BLE001 - the record is the point
+            errors[name] = f"{type(exc).__name__}: {str(exc)[:300]}"
+            log(f"[bench] {name} FAILED: {errors[name]}")
+            log(traceback.format_exc())
+            emit_partial(name, {"error": errors[name]})
+            return None
+
     log("[bench] building synthetic merged-campaign surrogate ...")
-    times, intervals = build_surrogate(par, intervals_path, template,
-                                       events_per_toa=events_per_toa)
+    built = step("surrogate", build_surrogate, par, intervals_path, template,
+                 events_per_toa=events_per_toa)
+    if built is None:
+        record = {
+            "metric": "toa_extraction_throughput_84toa_res1000",
+            "value": None, "unit": "ToA/s", "vs_baseline": None,
+            "platform": platform, "errors": errors,
+        }
+        emit_partial("final", record)
+        print(json.dumps(record))
+        return
+    times, intervals = built
     log(f"[bench] surrogate: {len(times)} events over {len(intervals)} intervals")
 
-    z2 = bench_z2(times, n_trials=z2_trials)
-    log(f"[bench] Z^2 {z2_trials} trials x {z2['n_events']} events: {z2['wall_s']:.2f}s "
-        f"({z2['trials_per_sec']:.0f} trials/s), peak {z2['peak']:.0f} at {z2['peak_freq']:.6f} Hz")
+    z2 = step("z2", bench_z2, times, n_trials=z2_trials)
+    if z2:
+        log(f"[bench] Z^2 {z2_trials} trials x {z2['n_events']} events: {z2['wall_s']:.2f}s "
+            f"({z2['trials_per_sec']:.0f} trials/s), peak {z2['peak']:.0f} at {z2['peak_freq']:.6f} Hz")
 
-    toas = bench_toas(par, intervals_path, template, times, intervals)
-    log(f"[bench] {toas['n_toas']} ToAs in {toas['wall_s']:.2f}s = {toas['toas_per_sec']:.1f} ToA/s "
-        f"(median |phShift| {toas['median_abs_phshift']:.4f} rad, median err {toas['median_err']:.4f}, "
-        f"median H {toas['median_H']:.0f})")
+    toas = step("toas", bench_toas, par, intervals_path, template, times, intervals)
+    if toas:
+        log(f"[bench] {toas['n_toas']} ToAs in {toas['wall_s']:.2f}s = {toas['toas_per_sec']:.1f} ToA/s "
+            f"(median |phShift| {toas['median_abs_phshift']:.4f} rad, median err {toas['median_err']:.4f}, "
+            f"median H {toas['median_H']:.0f})")
     log(f"[bench] reference: {REFERENCE_TOAS_PER_SEC:.4f} ToA/s (202 s for 84 ToAs, data/ToAs_2259.log)")
 
     # the scan half of the north star uses whichever trig path the A/B just
@@ -442,48 +566,62 @@ def main():
     # workload stayed inside the accuracy budget (never trade correctness
     # for the headline number)
     use_poly = bool(
-        z2["trials_per_sec_poly"]
+        z2
+        and z2["trials_per_sec_poly"]
         and z2["trials_per_sec_poly"] > 1.2 * z2["trials_per_sec"]
         and z2["rel_dev_poly"] is not None
         and z2["rel_dev_poly"] < 1e-3
     )
-    north = bench_north_star(par, template, times, intervals, n_freq=ns_freq,
-                             n_fdot=ns_fdot, poly_trig=use_poly)
-    log(f"[bench] NORTH STAR one-run: 2-D Z^2 {north['n_trials_2d']} trials + "
-        f"{north['n_toas']} ToAs in {north['wall_s']:.2f}s (target <10s, "
-        f"{'poly' if use_poly else 'hw'} trig); "
-        f"peak Z^2 {north['peak_z2']:.0f} at {north['peak_freq']:.6f} Hz")
+    north = step("north_star", bench_north_star, par, template, times, intervals,
+                 n_freq=ns_freq, n_fdot=ns_fdot, poly_trig=use_poly)
+    if north:
+        log(f"[bench] NORTH STAR one-run: 2-D Z^2 {north['n_trials_2d']} trials + "
+            f"{north['n_toas']} ToAs in {north['wall_s']:.2f}s (target <10s, "
+            f"{'poly' if use_poly else 'hw'} trig); "
+            f"peak Z^2 {north['peak_z2']:.0f} at {north['peak_freq']:.6f} Hz")
 
-    cfg4 = bench_config4(template, n_segments=cfg4_segments, events_per_seg=cfg4_events)
-    log(f"[bench] config-4: {cfg4['n_segments']} segments in {cfg4['wall_s']:.2f}s = "
-        f"{cfg4['toas_per_sec']:.1f} ToA/s; {100*cfg4['recovered_frac']:.1f}% of injected "
-        f"shifts recovered within 5 sigma")
+    cfg4 = step("config4", bench_config4, template, n_segments=cfg4_segments,
+                events_per_seg=cfg4_events)
+    if cfg4:
+        log(f"[bench] config-4: {cfg4['n_segments']} segments in {cfg4['wall_s']:.2f}s = "
+            f"{cfg4['toas_per_sec']:.1f} ToA/s; {100*cfg4['recovered_frac']:.1f}% of injected "
+            f"shifts recovered within 5 sigma")
 
-    print(json.dumps({
+    record = {
         "metric": "toa_extraction_throughput_84toa_res1000",
-        "value": round(toas["toas_per_sec"], 3),
+        "value": round(toas["toas_per_sec"], 3) if toas else None,
         "unit": "ToA/s",
-        "vs_baseline": round(toas["toas_per_sec"] / REFERENCE_TOAS_PER_SEC, 2),
+        "vs_baseline": (
+            round(toas["toas_per_sec"] / REFERENCE_TOAS_PER_SEC, 2) if toas else None
+        ),
         "platform": platform,
         "cpu_scaled_workloads": on_cpu,
-        "north_star_trials": north["n_trials_2d"],
+        "north_star_trials": north["n_trials_2d"] if north else None,
         "north_star_poly_trig": use_poly,
-        "north_star_wall_s": round(north["wall_s"], 3),
-        "north_star_under_10s": (north["wall_s"] < 10.0) and not on_cpu,
-        "z2_trials_per_sec": round(z2["trials_per_sec"], 1),
+        "north_star_wall_s": round(north["wall_s"], 3) if north else None,
+        "north_star_under_10s": (
+            bool(north and north["wall_s"] < 10.0) and not on_cpu
+        ),
+        "z2_trials_per_sec": round(z2["trials_per_sec"], 1) if z2 else None,
         "z2_trials_per_sec_poly": (
-            round(z2["trials_per_sec_poly"], 1) if z2["trials_per_sec_poly"] else None
+            round(z2["trials_per_sec_poly"], 1)
+            if z2 and z2["trials_per_sec_poly"] else None
         ),
-        "z2_rel_dev_poly": z2["rel_dev_poly"],
+        "z2_rel_dev_poly": z2["rel_dev_poly"] if z2 else None,
         "z2_trials_per_sec_pallas": (
-            round(z2["trials_per_sec_pallas"], 1) if z2["trials_per_sec_pallas"] else None
+            round(z2["trials_per_sec_pallas"], 1)
+            if z2 and z2["trials_per_sec_pallas"] else None
         ),
-        "z2_rel_dev_pallas": z2["rel_dev_pallas"],
-        "config4_n_segments": cfg4["n_segments"],
-        "config4_wall_s": round(cfg4["wall_s"], 3),
-        "config4_toas_per_sec": round(cfg4["toas_per_sec"], 1),
-        "config4_recovered_frac": cfg4["recovered_frac"],
-    }))
+        "z2_rel_dev_pallas": z2["rel_dev_pallas"] if z2 else None,
+        "config4_n_segments": cfg4["n_segments"] if cfg4 else None,
+        "config4_wall_s": round(cfg4["wall_s"], 3) if cfg4 else None,
+        "config4_toas_per_sec": round(cfg4["toas_per_sec"], 1) if cfg4 else None,
+        "config4_recovered_frac": cfg4["recovered_frac"] if cfg4 else None,
+    }
+    if errors:
+        record["errors"] = errors
+    emit_partial("final", record)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

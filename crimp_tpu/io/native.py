@@ -30,10 +30,19 @@ def load() -> ctypes.CDLL | None:
         return _lib
     _load_attempted = True
     try:
-        if not _LIB_PATH.exists():
+        # the library is a build artifact, never versioned: make is a no-op
+        # when libcrimpio.so is current and rebuilds it when crimpio.cpp
+        # changed (or after a fresh clone). A FAILED make must not disable
+        # a loadable library already on disk (toolchain-less machines).
+        try:
             subprocess.run(
                 ["make", "-C", str(_NATIVE_DIR)], check=True, capture_output=True
             )
+        except (OSError, subprocess.CalledProcessError) as exc:
+            if not _LIB_PATH.exists():
+                raise
+            logger.info("native rebuild failed (%s); loading existing %s",
+                        exc, _LIB_PATH.name)
         lib = ctypes.CDLL(str(_LIB_PATH))
     except (OSError, subprocess.CalledProcessError) as exc:
         logger.info("native crimpio unavailable (%s); using pure-Python FITS path", exc)

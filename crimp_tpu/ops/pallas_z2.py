@@ -41,6 +41,27 @@ EVENT_CHUNK = 1024
 TILE_CHUNK = 32  # trial tiles whose f64 base rows are materialized at once
 
 
+def pallas_minimal_probe() -> float:
+    """Compile and run the smallest useful Mosaic kernel (y = x + 1 on one
+    (8, 128) f32 block) on the default backend; returns sum(y).
+
+    Exists to CLASSIFY Pallas failures, not to compute: if this kernel
+    cannot compile, the failure is the Mosaic toolchain/relay (r3/r4: the
+    axon remote-compile helper returned HTTP 500 before any kernel code
+    reached the chip), not the Z^2 kernel below. The tier A/B and
+    scripts/probe_pallas_min.py use it to decide skip-vs-fail.
+    """
+
+    def kernel(x_ref, y_ref):
+        y_ref[...] = x_ref[...] + 1.0
+
+    x = jnp.arange(8 * 128, dtype=jnp.float32).reshape(8, 128)
+    y = pl.pallas_call(
+        kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype)
+    )(x)
+    return float(jnp.sum(y))
+
+
 def _make_kernel(nharm: int, trial_tile: int):
     def kernel(base_ref, b_ref, w_ref, c_ref, s_ref):
         # Inputs are (rows, 1, events) with (1, 1, event_chunk) blocks: the

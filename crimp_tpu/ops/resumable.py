@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import logging
 import pathlib
 
 import numpy as np
@@ -55,6 +56,13 @@ def _fingerprint(times: np.ndarray, freqs: np.ndarray, fdots: np.ndarray,
         "n_freq": int(len(freqs)),
         "f_first": float(freqs[0]),
         "f_last": float(freqs[-1]),
+        # full-grid hash, not just endpoints: a NON-uniform grid sharing
+        # n/first/last with a uniform one must not adopt a store pinned to
+        # grid_fastpath=True (its chunks would be a different statistic and
+        # _compute_chunk would index uniform_grid()'s None)
+        "freqs_sha256": hashlib.sha256(
+            np.ascontiguousarray(np.asarray(freqs, dtype=np.float64)).tobytes()
+        ).hexdigest(),
         "fdots": [float(f) for f in np.atleast_1d(fdots)],
         "nharm": int(nharm),
         "chunk_trials": int(chunk_trials),
@@ -131,6 +139,9 @@ class ResumableScan:
                 adoptable = (
                     {k: v for k, v in existing.items() if k != "numeric_mode"}
                     == {k: v for k, v in fp.items() if k != "numeric_mode"}
+                    # a malformed/legacy manifest missing the pinned modes
+                    # is not adoptable — there is no mode to adopt
+                    and "poly_trig" in mode and "grid_fastpath" in mode
                     and mode.get("grid_blocks") == self._numeric_mode["grid_blocks"]
                     # an EXPLICIT constructor poly= that conflicts with the
                     # store's pinned mode is a real mismatch, not a
@@ -145,6 +156,14 @@ class ResumableScan:
                         "problem (manifest fingerprint mismatch); refusing to mix "
                         "chunks — use a fresh store directory"
                     )
+                # adopting must be VISIBLE: a run launched with (say)
+                # CRIMP_TPU_POLY_TRIG=1 that resumes an hw-trig store would
+                # otherwise compute hw trig with no indication why
+                logging.getLogger(__name__).warning(
+                    "resuming %s with the store's pinned numeric mode %s "
+                    "(freshly resolved preferences were %s)",
+                    self.store, mode, self._numeric_mode,
+                )
                 self.poly = bool(mode["poly_trig"])
                 self._fastpath = bool(mode["grid_fastpath"])
                 self._numeric_mode = mode

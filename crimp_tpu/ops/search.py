@@ -86,9 +86,11 @@ GRID_EVENT_BLOCK, GRID_TRIAL_BLOCK = _env_blocks(1 << 15, 512)
 # events), i.e. ~8e-4 of the noise scale at k=20 — and measured directly:
 # max |dH| = 7.8e-4 (1.2e-4 of sqrt-noise) at nharm=20 over a +-1e7 s
 # baseline, identical argmax (r4, CPU, poly on and off). 20 is the
-# reference's blind-search maximum (periodsearch.py htest default), so
-# every product workload now takes the f64-lean path; beyond that, auto
-# mode falls back to the exact-f64-phase general kernel.
+# conventional de Jager H-test maximum (the largest harmonic count any
+# product workload sweeps; the reference's own defaults are smaller —
+# nbrHarm=2 in periodsearch.py, 5 in measureToAs.py), so every product
+# workload now takes the f64-lean path; beyond that, auto mode falls
+# back to the exact-f64-phase general kernel.
 GRID_FASTPATH_MAX_NHARM = 20
 # Below this many (trial, event) pairs the dispatch/collective overhead of
 # auto-sharding outweighs the parallel win (PeriodSearch._mesh).

@@ -22,19 +22,49 @@ def main() -> int:
     repo = pathlib.Path(__file__).resolve().parents[1]
 
     bench_log = out / "bench.log"
-    if not bench_log.exists():
-        print(f"no {bench_log}; nothing to extract", file=sys.stderr)
-        return 1
     bench = None
-    for line in bench_log.read_text().splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                bench = json.loads(line)
-            except json.JSONDecodeError:
-                pass
+    if bench_log.exists():
+        for line in bench_log.read_text().splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    bench = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
     if not bench:
-        print("no JSON line in bench.log", file=sys.stderr)
+        # the bench process wedged before its final line: reconstruct what
+        # DID complete from the per-sub-measurement sidecar (bench.py
+        # emit_partial). Newest sidecar only — never stitch rows from
+        # different runs/files into one frankenstein record (bench.py also
+        # truncates its sidecar at start for the same reason).
+        partial = {}
+        sidecars = sorted(out.glob("bench_partial*.jsonl"),
+                          key=lambda p: p.stat().st_mtime, reverse=True)
+        if sidecars:
+            # newest ONLY — an empty newest sidecar means "nothing of the
+            # current run completed", not "borrow the previous run's rows"
+            for line in sidecars[0].read_text().splitlines():
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                partial[row.pop("stage", "?")] = row
+        if "final" in partial:
+            bench = partial["final"]
+        elif partial:
+            bench = {
+                "platform": partial.get("platform", {}).get("platform"),
+                "value": partial.get("toas", {}).get("toas_per_sec"),
+                "z2_trials_per_sec_poly": partial.get("z2", {}).get(
+                    "trials_per_sec_poly"),
+                "z2_trials_per_sec_pallas": partial.get("z2", {}).get(
+                    "trials_per_sec_pallas"),
+            }
+            print(f"bench.log had no final JSON; reconstructed "
+                  f"{sum(v is not None for v in bench.values())} fields from "
+                  "the partial sidecar", file=sys.stderr)
+    if not bench:
+        print("no JSON in bench.log nor bench_partial*.jsonl", file=sys.stderr)
         return 1
     if bench.get("platform") != "tpu":
         print(f"bench platform is {bench.get('platform')!r}, not tpu; refusing "

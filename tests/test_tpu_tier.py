@@ -284,6 +284,17 @@ class TestOnChipToABatch:
                 except Exception as exc:
                     out[f"trials_per_sec_{key}"] = None
                     out[f"{key}_error"] = f"{type(exc).__name__}: {str(exc)[:300]}"
+            if out.get("pallas_error") is not None:
+                # classify: if even the trivial Mosaic kernel cannot compile
+                # the failure is the toolchain/relay, not our kernel
+                from crimp_tpu.ops.pallas_z2 import pallas_minimal_probe
+                try:
+                    pallas_minimal_probe()
+                    out["pallas_minimal_ok"] = True
+                except Exception as exc:
+                    out["pallas_minimal_ok"] = False
+                    out["pallas_minimal_error"] = (
+                        f"{type(exc).__name__}: {str(exc)[:300]}")
             print(json.dumps(out))
             """,
             timeout=1800.0,
@@ -299,22 +310,29 @@ class TestOnChipToABatch:
         for key in ("trials_per_sec_poly", "trials_per_sec_pallas"):
             if result.get(key) is not None:
                 print(f"tier z2_{key}: {result[key]:.1f}")
-        err = result.get("pallas_error")
-        if err is not None and "remote_compile" in err:
-            # The relay's remote-compile helper crashes on Mosaic kernels
-            # (r4 bench hit the same HTTP 500 before any kernel code ran on
-            # the chip). That is an infrastructure ceiling, not a kernel
-            # regression — record it verbatim and keep the tier green so
-            # the session can converge; the promote/retire decision lives
-            # in docs/performance.md.
-            print(f"tier pallas: relay compile infra failure (recorded): {err}")
-        else:
-            assert err is None, err
-            assert result["pallas_max_rel_dev"] < 2e-2
+        # poly asserts FIRST: they must run even when the Pallas half of the
+        # A/B ends in a skip below
         assert result.get("poly_error") is None, result["poly_error"]
         assert result["poly_max_rel_dev"] < 5e-3
         assert_rate(result["trials_per_sec_poly"], "z2_trials_per_sec_poly",
                     sanity_floor=0.0)
+        err = result.get("pallas_error")
+        if err is None:
+            assert result["pallas_max_rel_dev"] < 2e-2
+        elif result.get("pallas_minimal_ok"):
+            # the trivial Mosaic kernel compiled but ours did not: a real
+            # kernel regression, never infrastructure
+            pytest.fail(f"Pallas Z^2 failed while the minimal Mosaic kernel "
+                        f"compiled: {err}")
+        else:
+            # Mosaic compiles are down wholesale (r3/r4: relay
+            # remote-compile helper HTTP 500 before any kernel code reached
+            # the chip). Skip — visibly recorded, never a green pass — so
+            # the missing A/B cannot hide across rounds.
+            pytest.skip(
+                "Pallas A/B blocked by Mosaic compile infrastructure "
+                f"(minimal kernel also fails: "
+                f"{result.get('pallas_minimal_error')}); Z^2 error: {err}")
 
     def test_mcmc_fold_path_device_vs_host_longdouble(self):
         """The ONE precision-critical device path not covered by the anchored
