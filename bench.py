@@ -71,8 +71,10 @@ def choose_platform(probe_timeout_s: float = 300.0) -> str:
     failed acquisition, not a platform choice, so it retries too.
 
     Knobs: ``CRIMP_TPU_BENCH_PLATFORM`` / ``JAX_PLATFORMS=cpu`` skip the
-    probe entirely; ``CRIMP_TPU_BENCH_PROBE_DEADLINE_S`` (default 3600 —
-    sized to ride out one stale-grant expiry) bounds the total wait;
+    probe entirely; ``CRIMP_TPU_BENCH_PROBE_DEADLINE_S`` (default 2400 —
+    most of a stale-grant expiry, while keeping worst-case bench wall
+    clock under any plausible caller timeout: a CPU-tagged record beats a
+    caller-killed run with no record at all) bounds the total wait;
     ``CRIMP_TPU_RELAY_PORT`` (default 8113) locates the relay.
     """
     import os
@@ -82,18 +84,20 @@ def choose_platform(probe_timeout_s: float = 300.0) -> str:
         return forced
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         return "cpu"
-    deadline_s = float(os.environ.get("CRIMP_TPU_BENCH_PROBE_DEADLINE_S", "3600"))
+    deadline_s = float(os.environ.get("CRIMP_TPU_BENCH_PROBE_DEADLINE_S", "2400"))
     port = int(os.environ.get("CRIMP_TPU_RELAY_PORT", "8113"))
     probe = "import jax; print(jax.devices()[0].platform)"
     deadline = time.monotonic() + deadline_s
     attempt = 0
     probed_with_port_closed = False
+    cpu_no_relay_streak = 0
     while True:
         port_open = relay_port_open(port)
         # Port-closed short-circuit: skip the expensive probe — but verify
         # the assumption ONCE per bench (an accelerator path that does not
-        # go through a local relay must still be discoverable).
-        if not port_open and probed_with_port_closed:
+        # go through a local relay must still be discoverable), and never
+        # while a CPU-machine conclusion awaits its confirming probe.
+        if not port_open and probed_with_port_closed and cpu_no_relay_streak == 0:
             if time.monotonic() >= deadline:
                 break
             log(f"[bench] relay port {port} closed; polling "
@@ -111,19 +115,28 @@ def choose_platform(probe_timeout_s: float = 300.0) -> str:
                 if platform != "cpu":
                     return platform
                 if not port_open:
-                    # the plugin itself says cpu AND there is no relay to
-                    # wait for: a genuinely accelerator-less machine —
-                    # waiting out the deadline would be pure stall
-                    log("[bench] no relay and the backend is cpu — "
-                        "this is a CPU machine")
-                    return "cpu"
-                log(f"[bench] backend probe attempt {attempt}: accelerator "
-                    "plugin fell back to cpu — retrying")
+                    # plugin says cpu AND no relay in sight: likely a
+                    # genuinely accelerator-less machine — but demand the
+                    # signal TWICE (a minute apart) so a relay mid-restart
+                    # cannot permanently tag the round-end record "cpu"
+                    cpu_no_relay_streak += 1
+                    if cpu_no_relay_streak >= 2:
+                        log("[bench] no relay and the backend is cpu "
+                            "(confirmed twice) — this is a CPU machine")
+                        return "cpu"
+                    log("[bench] backend is cpu with no relay port — "
+                        "confirming once more before concluding CPU-only")
+                else:
+                    cpu_no_relay_streak = 0
+                    log(f"[bench] backend probe attempt {attempt}: "
+                        "accelerator plugin fell back to cpu — retrying")
             else:
+                cpu_no_relay_streak = 0
                 log(f"[bench] backend probe attempt {attempt} failed "
                     f"(rc={out.returncode}): {out.stderr.strip()[-300:]}")
             retry_wait = 60.0
         except subprocess.TimeoutExpired:
+            cpu_no_relay_streak = 0
             log(f"[bench] backend probe attempt {attempt} timed out "
                 f"after {probe_timeout_s}s (relay wedged?)")
             # a timeout-killed probe can itself wedge the grant: re-probing

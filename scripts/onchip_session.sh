@@ -72,6 +72,18 @@ stage() {
     # stage waits for recovery instead of burning its timeout hanging.
     local name="$1"; shift
     local tmo="$1"; shift
+    # cached-green FIRST: replaying a done marker costs zero chip time, so
+    # neither a down relay nor the session deadline may rewrite an
+    # already-green stage as a skip (that would keep a relaunched session
+    # permanently non-green in watch_relay's eyes)
+    if [ "$DRY" != "1" ] && [ -f "$OUT/done_$name" ]; then
+        # a relaunch of the same outdir (watch_relay retries) must not
+        # re-burn serialized chip time on stages already green — their
+        # artifacts ($OUT/$name.log) are already on disk
+        echo "{\"stage\": \"$name\", \"rc\": 0, \"cached\": true}" >> "$RESULTS"
+        echo "=== [$name] SKIPPED: green in a previous attempt ===" | tee -a "$OUT/session.log"
+        return 0
+    fi
     if [ "${RELAY_DOWN:-0}" = "1" ]; then
         echo "{\"stage\": \"$name\", \"rc\": -2, \"skipped\": \"relay down\"}" >> "$RESULTS"
         echo "=== [$name] SKIPPED: relay down ===" | tee -a "$OUT/session.log"
@@ -83,14 +95,6 @@ stage() {
         # never start a stage whose timeout could overrun it
         echo "{\"stage\": \"$name\", \"rc\": -3, \"skipped\": \"session deadline\"}" >> "$RESULTS"
         echo "=== [$name] SKIPPED: would overrun session deadline ===" | tee -a "$OUT/session.log"
-        return 0
-    fi
-    if [ "$DRY" != "1" ] && [ -f "$OUT/done_$name" ]; then
-        # a relaunch of the same outdir (watch_relay retries) must not
-        # re-burn serialized chip time on stages already green — their
-        # artifacts ($OUT/$name.log) are already on disk
-        echo "{\"stage\": \"$name\", \"rc\": 0, \"cached\": true}" >> "$RESULTS"
-        echo "=== [$name] SKIPPED: green in a previous attempt ===" | tee -a "$OUT/session.log"
         return 0
     fi
     echo "=== [$name] $(date -u +%H:%M:%S) ===" | tee -a "$OUT/session.log"
