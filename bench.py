@@ -199,6 +199,15 @@ def build_surrogate(par_path: str, intervals_path: str, template_path: str, even
     return np.sort(np.concatenate(all_times)), intervals
 
 
+def slice_intervals(times: np.ndarray, starts, ends) -> list[np.ndarray]:
+    """Segments of the (sorted — build_surrogate sorts) surrogate per
+    interval; the shared binary-search helper keeps the timed host prep
+    O(log n) per interval."""
+    from crimp_tpu.ops.toafit import slice_sorted_intervals
+
+    return slice_sorted_intervals(times, starts, ends, assume_sorted=True)
+
+
 def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np.ndarray, intervals) -> dict:
     """Batched ToA extraction over the committed 84 intervals."""
     import jax.numpy as jnp
@@ -216,13 +225,8 @@ def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np
         starts = intervals["ToA_tstart"].to_numpy()
         ends = intervals["ToA_tend"].to_numpy()
         exposures = intervals["ToA_exposure"].to_numpy().astype(float)
-        toa_mids = np.zeros(len(intervals))
-        seg_times = []
-        for i in range(len(intervals)):
-            sel = (times >= starts[i]) & (times <= ends[i])
-            t_seg = times[sel]
-            toa_mids[i] = (t_seg[-1] - t_seg[0]) / 2 + t_seg[0]
-            seg_times.append(t_seg)
+        seg_times = slice_intervals(times, starts, ends)
+        toa_mids = np.array([(t[-1] - t[0]) / 2 + t[0] for t in seg_times])
         am = anchored.prepare_anchors(tm, toa_mids)
         seg_sizes = [t.size for t in seg_times]
         anchor_idx = np.repeat(np.arange(len(seg_times)), seg_sizes)
@@ -253,12 +257,8 @@ def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np
     # longdouble so the comparison measures device error, not cast noise.
     starts = intervals["ToA_tstart"].to_numpy()
     ends = intervals["ToA_tend"].to_numpy()
-    toa_mids = np.zeros(len(intervals))
-    seg_times = []
-    for i in range(len(intervals)):
-        t_seg = times[(times >= starts[i]) & (times <= ends[i])]
-        toa_mids[i] = (t_seg[-1] - t_seg[0]) / 2 + t_seg[0]
-        seg_times.append(t_seg)
+    seg_times = slice_intervals(times, starts, ends)
+    toa_mids = np.array([(t[-1] - t[0]) / 2 + t[0] for t in seg_times])
     am = anchored.prepare_anchors(tm, toa_mids)
     sizes = [t.size for t in seg_times]
     anchor_idx = np.repeat(np.arange(len(seg_times)), sizes)
@@ -373,12 +373,8 @@ def bench_north_star(par_path: str, template_path: str, times: np.ndarray, inter
         ps = search.PeriodSearch(sec, freqs, 2, poly_trig=poly_trig)
         rows, _ = ps.twod_ztest(log_fdots)
         # --- ToA extraction over the committed 84 intervals ----------------
-        toa_mids = np.zeros(len(intervals))
-        seg_times = []
-        for i in range(len(intervals)):
-            t_seg = times[(times >= starts[i]) & (times <= ends[i])]
-            toa_mids[i] = (t_seg[-1] - t_seg[0]) / 2 + t_seg[0]
-            seg_times.append(t_seg)
+        seg_times = slice_intervals(times, starts, ends)
+        toa_mids = np.array([(t[-1] - t[0]) / 2 + t[0] for t in seg_times])
         am = anchored.prepare_anchors(tm, toa_mids)
         sizes = [t.size for t in seg_times]
         anchor_idx = np.repeat(np.arange(len(seg_times)), sizes)

@@ -684,6 +684,28 @@ def fit_toas_batch_auto(
     return {k: v[:n_seg] for k, v in out.items()}
 
 
+def slice_sorted_intervals(times, starts, ends,
+                           assume_sorted: bool = False) -> list[np.ndarray]:
+    """Per-interval event segments of ``times`` over inclusive [start, end]
+    windows (host helper).
+
+    Sorted input (one O(n) check unless the caller vouches with
+    ``assume_sorted``) gets O(log n) binary-search slices per interval;
+    unsorted input falls back to boolean masks — the intervals × events
+    product makes per-interval masks the dominant host cost of segment
+    prep on campaign-sized event lists."""
+    times = np.asarray(times)
+    if not assume_sorted:
+        assume_sorted = bool(np.all(np.diff(times) >= 0))
+    if assume_sorted:
+        return [
+            times[np.searchsorted(times, s, "left"):
+                  np.searchsorted(times, e, "right")]
+            for s, e in zip(starts, ends)
+        ]
+    return [times[(times >= s) & (times <= e)] for s, e in zip(starts, ends)]
+
+
 def pad_segments(phase_list: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray]:
     """Pad ragged per-segment phase arrays to (S, Nmax) + mask (host helper)."""
     n_max = max((len(p) for p in phase_list), default=1)

@@ -85,15 +85,15 @@ def measure_toas(
     ends = intervals["ToA_tend"].to_numpy()
     exposures = intervals["ToA_exposure"].to_numpy()
 
-    toa_mids = np.zeros(len(idx_range))
-    seg_times: list[np.ndarray] = []
-    for out_i, ii in enumerate(idx_range):
-        sel = (times_all >= starts[ii]) & (times_all <= ends[ii])
-        t_seg = times_all[sel]
+    idx_list = list(idx_range)
+    seg_times = toafit.slice_sorted_intervals(
+        times_all, starts[idx_list], ends[idx_list]
+    )
+    toa_mids = np.zeros(len(idx_list))
+    for out_i, (ii, t_seg) in enumerate(zip(idx_list, seg_times)):
         if t_seg.size == 0:
             raise ValueError(f"ToA interval {ii} contains no events")
         toa_mids[out_i] = (t_seg[-1] - t_seg[0]) / 2 + t_seg[0]
-        seg_times.append(t_seg)
 
     # One anchor per ToA interval: the fold of every segment is exact.
     # All segments fold in a SINGLE device call (concatenated deltas with a
