@@ -6,7 +6,9 @@ rates stay above GUARD_FRAC x the officially recorded ones
 session artifacts. Only a session whose bench ran on the accelerator
 qualifies — a CPU-fallback bench must never become the guard.
 
-Usage: python scripts/extract_rates.py <session_outdir>
+Usage: python scripts/extract_rates.py <session_outdir> [dest_json]
+(``dest_json`` defaults to the repo's docs/onchip_rates.json; tests pass a
+scratch path.)
 """
 
 from __future__ import annotations
@@ -17,9 +19,11 @@ import re
 import sys
 
 
-def main() -> int:
-    out = pathlib.Path(sys.argv[1] if len(sys.argv) > 1 else "onchip_results")
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    out = pathlib.Path(argv[0] if argv else "onchip_results")
     repo = pathlib.Path(__file__).resolve().parents[1]
+    dest = pathlib.Path(argv[1]) if len(argv) > 1 else repo / "docs" / "onchip_rates.json"
 
     bench_log = out / "bench.log"
     bench = None
@@ -100,7 +104,6 @@ def main() -> int:
                 rates[key] = float(m.group(1))
 
     rates = {k: v for k, v in rates.items() if v is not None}
-    dest = repo / "docs" / "onchip_rates.json"
     # Ratchet, don't overwrite: keep the BEST recorded value per key so a
     # within-guard (sub-2x) regression can never lower the baseline and
     # compound silently across sessions. "Best" is key-specific: rates go

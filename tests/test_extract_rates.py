@@ -1,0 +1,153 @@
+"""scripts/extract_rates.py semantics: the session→perf-guard pipeline.
+
+This plumbing decides what docs/onchip_rates.json (the TPU tier's
+regression-guard record) says after every on-chip session; a bug here
+either poisons the guard with CPU rates or silently lowers the ratchet.
+Pinned: CPU refusal, tier-print extraction, best-value ratcheting in both
+directions, and the wedged-bench sidecar reconstruction (newest file only,
+'final' row preferred).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+spec = importlib.util.spec_from_file_location(
+    "extract_rates", REPO / "scripts" / "extract_rates.py"
+)
+extract_rates = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(extract_rates)
+
+
+BENCH_LINE = {
+    "metric": "toa_extraction_throughput_84toa_res1000",
+    "value": 25.0,
+    "platform": "tpu",
+    "z2_trials_per_sec_poly": 90000.0,
+    "z2_trials_per_sec_pallas": None,
+}
+
+
+def write_bench_log(outdir: pathlib.Path, record: dict) -> None:
+    outdir.mkdir(parents=True, exist_ok=True)
+    (outdir / "bench.log").write_text(
+        "[bench] some stderr noise\n" + json.dumps(record) + "\n"
+    )
+
+
+class TestRefusalAndExtraction:
+    def test_cpu_bench_is_refused(self, tmp_path):
+        out = tmp_path / "sess"
+        write_bench_log(out, {**BENCH_LINE, "platform": "cpu"})
+        dest = tmp_path / "rates.json"
+        assert extract_rates.main([str(out), str(dest)]) == 1
+        assert not dest.exists()
+
+    def test_missing_everything_is_an_error(self, tmp_path):
+        out = tmp_path / "empty"
+        out.mkdir()
+        assert extract_rates.main([str(out), str(tmp_path / "r.json")]) == 1
+
+    def test_tpu_bench_and_tier_prints_extracted(self, tmp_path):
+        out = tmp_path / "sess"
+        write_bench_log(out, BENCH_LINE)
+        (out / "tpu_tier.log").write_text(
+            "tier toas_per_sec: 30.5\n"
+            "tier z2_trials_per_sec_poly: 91500.2\n"
+            "C_trig (FMA-op equivalents per sin/cos): 12.3\n"
+        )
+        dest = tmp_path / "rates.json"
+        assert extract_rates.main([str(out), str(dest)]) == 0
+        rates = json.loads(dest.read_text())
+        assert rates["platform"] == "tpu"
+        assert rates["toas_per_sec_pipeline"] == 25.0
+        assert rates["toas_per_sec"] == 30.5
+        assert rates["z2_trials_per_sec_poly"] == 91500.2
+        assert rates["c_trig_ops_equiv"] == 12.3
+
+
+class TestRatchet:
+    def test_rates_only_go_up_and_ctrig_only_down(self, tmp_path):
+        out = tmp_path / "sess"
+        write_bench_log(out, BENCH_LINE)
+        (out / "tpu_tier.log").write_text(
+            "tier toas_per_sec: 20.0\n"
+            "C_trig (FMA-op equivalents per sin/cos): 15.0\n"
+        )
+        dest = tmp_path / "rates.json"
+        dest.write_text(json.dumps({
+            "toas_per_sec": 30.0,          # better than the new 20.0
+            "c_trig_ops_equiv": 10.0,      # better (lower) than the new 15.0
+            "toas_per_sec_pipeline": 10.0,  # worse than the new 25.0
+        }))
+        assert extract_rates.main([str(out), str(dest)]) == 0
+        rates = json.loads(dest.read_text())
+        assert rates["toas_per_sec"] == 30.0          # kept the better old
+        assert rates["c_trig_ops_equiv"] == 10.0      # kept the better old
+        assert rates["toas_per_sec_pipeline"] == 25.0  # took the better new
+
+    def test_retired_keys_do_not_leak_from_old_record(self, tmp_path):
+        out = tmp_path / "sess"
+        write_bench_log(out, BENCH_LINE)
+        dest = tmp_path / "rates.json"
+        dest.write_text(json.dumps({"some_retired_rate": 1.0}))
+        assert extract_rates.main([str(out), str(dest)]) == 0
+        assert "some_retired_rate" not in json.loads(dest.read_text())
+
+
+class TestSidecarReconstruction:
+    def test_final_row_preferred(self, tmp_path):
+        out = tmp_path / "sess"
+        out.mkdir()
+        (out / "bench_partial.jsonl").write_text(
+            json.dumps({"stage": "platform", "platform": "tpu"}) + "\n"
+            + json.dumps({"stage": "z2", "trials_per_sec_poly": 100.0}) + "\n"
+            + json.dumps({"stage": "final", **BENCH_LINE}) + "\n"
+        )
+        dest = tmp_path / "rates.json"
+        assert extract_rates.main([str(out), str(dest)]) == 0
+        assert json.loads(dest.read_text())["toas_per_sec_pipeline"] == 25.0
+
+    def test_wedged_run_reconstructed_from_stage_rows(self, tmp_path):
+        out = tmp_path / "sess"
+        out.mkdir()
+        (out / "bench_partial.jsonl").write_text(
+            json.dumps({"stage": "platform", "platform": "tpu"}) + "\n"
+            + json.dumps({"stage": "z2", "trials_per_sec_poly": 80000.0}) + "\n"
+            + json.dumps({"stage": "toas", "toas_per_sec": 24.0}) + "\n"
+        )
+        dest = tmp_path / "rates.json"
+        assert extract_rates.main([str(out), str(dest)]) == 0
+        rates = json.loads(dest.read_text())
+        assert rates["toas_per_sec_pipeline"] == 24.0
+        # bench-sourced Z^2 rates carry the _bench suffix: the unsuffixed
+        # guard keys are reserved for the tier's canonical workload
+        assert rates["z2_trials_per_sec_poly_bench"] == 80000.0
+
+    def test_empty_newest_sidecar_never_borrows_an_older_run(self, tmp_path):
+        import os
+        import time
+
+        out = tmp_path / "sess"
+        out.mkdir()
+        older = out / "bench_partial.jsonl"
+        older.write_text(json.dumps({"stage": "final", **BENCH_LINE}) + "\n")
+        newer = out / "bench_partial_late.jsonl"
+        newer.write_text("")  # truncated at start, wedged before first emit
+        t = time.time()
+        os.utime(older, (t - 100, t - 100))
+        os.utime(newer, (t, t))
+        assert extract_rates.main([str(out), str(tmp_path / "r.json")]) == 1
+
+    def test_cpu_sidecar_refused(self, tmp_path):
+        out = tmp_path / "sess"
+        out.mkdir()
+        (out / "bench_partial.jsonl").write_text(
+            json.dumps({"stage": "platform", "platform": "cpu"}) + "\n"
+            + json.dumps({"stage": "toas", "toas_per_sec": 14.0}) + "\n"
+        )
+        assert extract_rates.main([str(out), str(tmp_path / "r.json")]) == 1
