@@ -124,6 +124,60 @@ class TestResumableScan:
             ResumableScan(events, freqs, nharm=2, store=str(store),
                           chunk_trials=200, poly=True)
 
+    def test_adoption_logs_the_pinned_mode(self, events, tmp_path,
+                                           monkeypatch, caplog):
+        """Adopting the store's numeric mode over a fresh env preference
+        must be VISIBLE (a CRIMP_TPU_POLY_TRIG=1 run resuming an hw-trig
+        store would otherwise compute hw trig with no indication why)."""
+        import logging
+
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.delenv("CRIMP_TPU_POLY_TRIG", raising=False)
+        ResumableScan(events, freqs, nharm=2, store=str(store),
+                      chunk_trials=200)
+        monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "1")
+        with caplog.at_level(logging.WARNING, logger="crimp_tpu.ops.resumable"):
+            ResumableScan(events, freqs, nharm=2, store=str(store),
+                          chunk_trials=200)
+        assert any("pinned numeric mode" in r.message for r in caplog.records)
+
+    def test_nonuniform_grid_same_endpoints_refused(self, events, tmp_path):
+        """A NON-uniform grid sharing n/first/last with a uniform store must
+        refuse (the store may be pinned to grid_fastpath=True, whose chunks
+        are a different statistic and whose dispatch needs a uniform df)."""
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        ResumableScan(events, freqs, nharm=2, store=str(store),
+                      chunk_trials=200).run()
+        warped = freqs.copy()
+        warped[1:-1] = freqs[1:-1] + 1e-7 * np.sin(np.arange(398))
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, warped, nharm=2, store=str(store),
+                          chunk_trials=200)
+
+    def test_malformed_manifest_mode_refused(self, events, tmp_path,
+                                             monkeypatch):
+        """A manifest whose numeric_mode lacks the pinned keys is not
+        adoptable — there is no mode to adopt (must refuse cleanly, never
+        KeyError)."""
+        import json
+
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.delenv("CRIMP_TPU_POLY_TRIG", raising=False)
+        ResumableScan(events, freqs, nharm=2, store=str(store),
+                      chunk_trials=200).run()
+        manifest = store / "manifest.json"
+        fp = json.loads(manifest.read_text())
+        # deleting the key alone already desyncs the manifest from the
+        # fresh fingerprint, so the adoption path is what examines it
+        del fp["numeric_mode"]["poly_trig"]
+        manifest.write_text(json.dumps(fp))
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, store=str(store),
+                          chunk_trials=200)
+
     def test_store_refuses_block_tiling_change(self, events, tmp_path, monkeypatch):
         """Block tiling is a module constant this instance cannot adopt —
         a store written under different grid blocks still refuses."""
