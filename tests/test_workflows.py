@@ -195,6 +195,22 @@ class TestCLISmoke:
         assert exc.value.code == 0
         assert capsys.readouterr().out.strip()
 
+    def test_pyproject_registers_the_full_surface(self):
+        """The 12 reference-named console scripts must stay registered in
+        pyproject and resolve to real cli functions — the help smoke above
+        cannot catch a script dropped from [project.scripts] alone."""
+        import pathlib
+        import re
+
+        from crimp_tpu import cli
+
+        text = (pathlib.Path(__file__).parents[1] / "pyproject.toml").read_text()
+        block = text.split("[project.scripts]", 1)[1].split("[", 1)[0]
+        entries = dict(re.findall(r'(\w+) = "crimp_tpu\.cli:(\w+)"', block))
+        assert len(entries) == 12
+        for script, func in entries.items():
+            assert callable(getattr(cli, func)), script
+
     def test_ephemintegerrotation_runs(self, capsys):
         from crimp_tpu import cli
 
