@@ -31,4 +31,26 @@ import jax as _jax
 
 _jax.config.update("jax_enable_x64", True)
 
+# Persistent compilation cache + compile telemetry: config-only at import
+# (no backend init — the relay-window scripts depend on `import crimp_tpu`
+# not acquiring devices). CRIMP_TPU_COMPILE_CACHE=off disables.
+from crimp_tpu.utils.platform import configure_compilation_cache as _cfg_cache  # noqa: E402
+from crimp_tpu.utils.profiling import install_compile_listeners as _listeners  # noqa: E402
+
+_cfg_cache()
+_listeners()
+
 __version__ = "0.1.0"
+
+
+def warmup(**kwargs):
+    """AOT-lower-and-compile the hot kernels at their real shapes.
+
+    Thin lazy delegate to :func:`crimp_tpu.aot.warmup` so sessions can
+    pre-pay all compilation (and populate the persistent cache) before
+    the timed window opens. Importing crimp_tpu stays cheap; calling
+    this initializes the backend.
+    """
+    from crimp_tpu import aot
+
+    return aot.warmup(**kwargs)

@@ -1,0 +1,153 @@
+"""AOT warmup: lower-and-compile the hot kernels before the timed window.
+
+Every scarce relay window was burning minutes JIT-compiling the same four
+kernels before measuring anything. ``warmup()`` pays that cost up front —
+ideally right after session start, while the chip grant is fresh — by
+AOT-lowering each hot kernel at its REAL shapes and compiling it. Combined
+with the persistent compilation cache (utils/platform.py) the compiled
+binaries also survive process restarts, so the second session of a round
+warms up from disk in milliseconds.
+
+Shape discipline: the AOT calls must produce exactly the jit-cache entries
+the runtime calls will look up. Dynamic arrays are described with
+``jax.ShapeDtypeStruct``; *static* scalars (n_freq, nharm, blocks) and
+*weak-typed* python floats (f0, df, fdot) are passed as the same python
+values the runtime wrappers pass, so the traced avals match bit-for-bit.
+Block sizes are resolved through the autotuner exactly as at runtime.
+"""
+
+from __future__ import annotations
+
+import time
+
+from crimp_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _lower_compile(report: dict, name: str, fn, *args, **kwargs) -> None:
+    """AOT-compile one target; record wall time or the error (a warmup
+    failure must degrade to 'that kernel JITs later', never crash)."""
+    t0 = time.perf_counter()
+    try:
+        fn.lower(*args, **kwargs).compile()
+        report["targets"][name] = {"s": round(time.perf_counter() - t0, 3)}
+    except Exception as exc:  # noqa: BLE001
+        report["targets"][name] = {
+            "error": f"{type(exc).__name__}: {str(exc)[:200]}"
+        }
+        logger.warning("warmup target %s failed: %s", name, exc)
+
+
+def warmup(
+    n_events: int,
+    n_trials: int,
+    nharm: int = 2,
+    n_fdot: int = 0,
+    n_freq_2d: int | None = None,
+    poly: bool | None = None,
+    toa: dict | None = None,
+    mcmc: dict | bool | None = None,
+) -> dict:
+    """Compile the hot kernels for the given problem shapes.
+
+    - uniform-grid Z^2/H 1-D sums at (n_events, n_trials) — ``poly=None``
+      warms BOTH trig paths, since the A/B benchmark times both;
+    - the 2-D (f, fdot) grid kernel when ``n_fdot`` > 0 (at ``n_freq_2d``
+      trial frequencies, default ``n_trials``);
+    - the batched ToA fit when ``toa`` is given: a dict with keys ``tpl``
+      (ProfileParams), ``n_segments``, ``n_events_max``, and optionally
+      ``kind``/``cfg``;
+    - the ensemble-MCMC step when ``mcmc`` is given: True for the default
+      (32 walkers, 3 dims, 500 steps, standard-normal log-prob) or a dict
+      with ``walkers``/``ndim``/``steps`` and optionally ``log_prob_fn``.
+
+    Returns {"targets": {name: {"s": ...} | {"error": ...}}, "total_s",
+    "counters"} — counters are the compile/cache telemetry deltas from
+    utils.profiling, showing how much came from the persistent cache.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from crimp_tpu.ops import autotune, search
+    from crimp_tpu.utils import profiling
+
+    profiling.install_compile_listeners()
+    before = profiling.compile_counters()
+    report: dict = {"targets": {}}
+    t_start = time.perf_counter()
+
+    times_sds = jax.ShapeDtypeStruct((int(n_events),), jnp.float64)
+    # f0/df values are irrelevant to the compiled program (weak-typed f64
+    # scalars are traced by aval, not value) — any floats produce the same
+    # executable the runtime call will look up.
+    f0, df = 0.143, 6e-9
+    poly_paths = (False, True) if poly is None else (bool(poly),)
+
+    eb, tb = autotune.resolve_blocks("grid", int(n_events), int(n_trials))
+    for p in poly_paths:
+        _lower_compile(
+            report, f"harmonic_sums_uniform[poly={int(p)}]",
+            search.harmonic_sums_uniform, times_sds, f0, df, int(n_trials),
+            int(nharm), event_block=eb, trial_block=tb, poly=p,
+        )
+
+    if n_fdot:
+        nf2 = int(n_freq_2d if n_freq_2d is not None else n_trials)
+        eb2, tb2 = autotune.resolve_blocks("grid", int(n_events), nf2)
+        fdots_sds = jax.ShapeDtypeStruct((int(n_fdot),), jnp.float64)
+        for p in poly_paths:
+            _lower_compile(
+                report, f"harmonic_sums_uniform_2d[poly={int(p)}]",
+                search.harmonic_sums_uniform_2d, times_sds, f0, df, nf2,
+                fdots_sds, int(nharm), event_block=eb2, trial_block=tb2,
+                poly=p,
+            )
+
+    if toa is not None:
+        from crimp_tpu.ops import toafit
+
+        kind = toa.get("kind", toafit.ToAFitConfig().kind)
+        cfg = toa.get("cfg", toafit.ToAFitConfig(kind=kind))
+        s, n = int(toa["n_segments"]), int(toa["n_events_max"])
+        _lower_compile(
+            report, "fit_toas_batch",
+            toafit.fit_toas_batch, kind, toa["tpl"],
+            jax.ShapeDtypeStruct((s, n), jnp.float64),
+            jax.ShapeDtypeStruct((s, n), jnp.bool_),
+            jax.ShapeDtypeStruct((s,), jnp.float64),
+            cfg,
+        )
+
+    if mcmc:
+        from crimp_tpu.ops import mcmc as mcmc_mod
+
+        spec = mcmc if isinstance(mcmc, dict) else {}
+        walkers = int(spec.get("walkers", 32))
+        ndim = int(spec.get("ndim", 3))
+        steps = int(spec.get("steps", 500))
+        log_prob_fn = spec.get(
+            "log_prob_fn", lambda p: -0.5 * jnp.sum(p * p)
+        )
+        _lower_compile(
+            report, "ensemble_sample",
+            mcmc_mod.ensemble_sample, log_prob_fn,
+            jax.ShapeDtypeStruct((walkers, ndim), jnp.float64),
+            steps, jax.random.PRNGKey(0),
+        )
+
+    after = profiling.compile_counters()
+    report["total_s"] = round(time.perf_counter() - t_start, 3)
+    report["counters"] = {
+        "cache_hits": after["cache_hits"] - before["cache_hits"],
+        "cache_misses": after["cache_misses"] - before["cache_misses"],
+        "backend_compile_s": round(
+            after["backend_compile_s"] - before["backend_compile_s"], 4),
+        "cache_retrieval_s": round(
+            after["cache_retrieval_s"] - before["cache_retrieval_s"], 4),
+    }
+    n_ok = sum(1 for t in report["targets"].values() if "s" in t)
+    logger.info("warmup: %d/%d targets compiled in %.2fs (%d cache hits)",
+                n_ok, len(report["targets"]), report["total_s"],
+                report["counters"]["cache_hits"])
+    return report

@@ -1,0 +1,317 @@
+"""Block-size autotuner for the blockwise Z^2/H kernels.
+
+The (event_block, trial_block) tiling of the search kernels is a pure
+throughput knob (the statistic is block-invariant — tests/test_search.py
+pins that), but the optimum moves with backend, device generation, trig
+path and problem size: the hand-set GRID defaults were swept on v5e
+BEFORE poly trig landed (docs/performance.md). This module makes the
+tuning automatic and persistent instead of a one-off script:
+
+- ``tune()`` times a small candidate grid on the canonical A/B workload
+  (crimp_tpu/utils/benchwork.py — the same problem the sweep script, the
+  TPU tier and the recorded perf guards measure) and persists the winner
+  in a fingerprinted on-disk cache;
+- ``resolve_blocks()`` is the single resolution point the kernels call:
+  explicit arguments and the ``CRIMP_TPU_GRID_BLOCKS`` env knob stay hard
+  overrides, a cached winner is used when present, and the static
+  module defaults remain the fallback (so a fresh machine behaves exactly
+  as before until someone tunes).
+
+Cache key schema (one JSON file, atomic tmp+rename writes)::
+
+    <platform>|<device_kind>|<kernel>|poly<0/1>|ev<ceil log2 n_events>|tr<ceil log2 n_trials>
+
+``kernel`` is the variant family: "grid" (uniform-grid fast path, also
+used by the 2-D grid kernel — same inner tile structure) or "general"
+(arbitrary-frequency blockwise kernel). Problem sizes are bucketed to
+their ceil-log2 so a 7.9e5-event scan and an 8.1e5-event scan share a
+tuning, while 1e5 and 1e8 do not.
+
+Env knobs:
+
+- ``CRIMP_TPU_AUTOTUNE``: ``0/off`` = static defaults only (today's
+  behavior); unset/``auto`` = use a cached winner when present, never
+  time anything implicitly; ``1/on/eager`` = tune-and-persist on a cache
+  miss (timing runs happen inside library calls — opt-in only).
+- ``CRIMP_TPU_AUTOTUNE_CACHE``: cache file path (default
+  ``$XDG_CACHE_HOME/crimp_tpu/autotune.json``).
+- ``CRIMP_TPU_GRID_BLOCKS``: hard override for the grid kernels,
+  unchanged semantics (malformed values raise).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pathlib
+import time
+
+logger = logging.getLogger(__name__)
+
+CACHE_VERSION = 1
+
+# The small default candidate grid tune() times: bracket both static
+# defaults (2^15/512 grid, 2^16/256 general) so the winner can never be
+# slower than what an untuned install would pick.
+DEFAULT_CANDIDATES = (
+    (1 << 14, 256),
+    (1 << 14, 512),
+    (1 << 15, 256),
+    (1 << 15, 512),
+    (1 << 15, 1024),
+    (1 << 16, 256),
+    (1 << 16, 512),
+    (1 << 16, 1024),
+    (1 << 17, 512),
+)
+
+
+# -- policy / key -----------------------------------------------------------
+
+
+def autotune_mode() -> str:
+    """'off' | 'auto' | 'eager' from CRIMP_TPU_AUTOTUNE (malformed raises)."""
+    env = os.environ.get("CRIMP_TPU_AUTOTUNE", "auto").strip().lower()
+    if env in ("0", "off", "false", "never"):
+        return "off"
+    if env in ("", "auto", "cache"):
+        return "auto"
+    if env in ("1", "on", "true", "eager"):
+        return "eager"
+    raise ValueError(
+        f"CRIMP_TPU_AUTOTUNE={env!r} not recognized; expected 0/off, auto, "
+        "or 1/on (eager tuning)"
+    )
+
+
+def cache_path() -> pathlib.Path:
+    env = os.environ.get("CRIMP_TPU_AUTOTUNE_CACHE", "").strip()
+    if env:
+        return pathlib.Path(env)
+    base = os.environ.get("XDG_CACHE_HOME", "").strip() or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return pathlib.Path(base) / "crimp_tpu" / "autotune.json"
+
+
+def _bucket(n: int) -> int:
+    """ceil(log2(n)) — problem sizes within a factor of 2 share a tuning."""
+    return max(1, int(n) - 1).bit_length()
+
+
+def device_fingerprint() -> tuple[str, str]:
+    """(platform, device_kind) of the default device — initializes the
+    backend, so only resolution paths that actually consult the cache call
+    this (plain static-default resolution must stay import-safe)."""
+    import jax
+
+    dev = jax.devices()[0]
+    return jax.default_backend(), getattr(dev, "device_kind", "unknown")
+
+
+def cache_key(kernel: str, poly: bool, n_events: int, n_trials: int,
+              platform: str | None = None, device_kind: str | None = None) -> str:
+    if platform is None or device_kind is None:
+        platform, device_kind = device_fingerprint()
+    return "|".join([
+        platform, device_kind, kernel, f"poly{int(bool(poly))}",
+        f"ev{_bucket(n_events)}", f"tr{_bucket(n_trials)}",
+    ])
+
+
+# -- on-disk cache ----------------------------------------------------------
+
+
+def _load_cache(path: pathlib.Path | None = None) -> dict:
+    path = cache_path() if path is None else path
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError, ValueError):
+        return {}
+    if not isinstance(doc, dict) or doc.get("version") != CACHE_VERSION:
+        return {}
+    entries = doc.get("entries")
+    return entries if isinstance(entries, dict) else {}
+
+
+def _store_entry(key: str, entry: dict, path: pathlib.Path | None = None) -> None:
+    """Merge one winner into the cache file (atomic tmp+rename)."""
+    path = cache_path() if path is None else path
+    entries = _load_cache(path)
+    entries[key] = entry
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(json.dumps({"version": CACHE_VERSION, "entries": entries},
+                              indent=2) + "\n")
+    tmp.rename(path)
+
+
+def cached_blocks(kernel: str, poly: bool, n_events: int, n_trials: int) -> tuple[int, int] | None:
+    entry = _load_cache().get(cache_key(kernel, poly, n_events, n_trials))
+    if not isinstance(entry, dict):
+        return None
+    eb, tb = entry.get("event_block"), entry.get("trial_block")
+    if isinstance(eb, int) and isinstance(tb, int) and eb > 0 and tb > 0:
+        return eb, tb
+    return None
+
+
+# -- resolution -------------------------------------------------------------
+
+
+def static_defaults(kernel: str) -> tuple[int, int]:
+    from crimp_tpu.ops import search
+
+    if kernel == "general":
+        return search.DEFAULT_EVENT_BLOCK, search.DEFAULT_TRIAL_BLOCK
+    return search.GRID_EVENT_BLOCK, search.GRID_TRIAL_BLOCK
+
+
+def env_blocks_override(kernel: str) -> tuple[int, int] | None:
+    """Live CRIMP_TPU_GRID_BLOCKS value (grid kernels only; keeps today's
+    meaning — the knob has always targeted the uniform-grid fast path).
+    Re-read per call so it beats the cache even when set after import."""
+    if kernel == "general":
+        return None
+    from crimp_tpu.ops import search
+
+    env = os.environ.get("CRIMP_TPU_GRID_BLOCKS", "").strip()
+    if not env:
+        return None
+    return search._env_blocks(*static_defaults(kernel))
+
+
+def resolve_blocks(kernel: str, n_events: int, n_trials: int,
+                   poly: bool = False,
+                   event_block: int | None = None,
+                   trial_block: int | None = None) -> tuple[int, int]:
+    """The single block-resolution point for the search kernels.
+
+    Precedence: explicit arguments > CRIMP_TPU_GRID_BLOCKS (grid kernels)
+    > cached tuner winner (unless CRIMP_TPU_AUTOTUNE=0) > eager tune on
+    miss (only when CRIMP_TPU_AUTOTUNE=1) > static module defaults.
+    Never runs timing unless eager mode is opted into.
+    """
+    if kernel not in ("grid", "general"):
+        raise ValueError(f"unknown kernel variant {kernel!r}")
+    if event_block is not None and trial_block is not None:
+        return int(event_block), int(trial_block)
+    env = env_blocks_override(kernel)
+    mode = autotune_mode()
+    resolved = None
+    if env is not None:
+        resolved = env
+    elif mode != "off":
+        try:
+            resolved = cached_blocks(kernel, poly, n_events, n_trials)
+        except Exception:  # noqa: BLE001 — a corrupt cache or an
+            # uninitializable backend must never take down a search call
+            logger.warning("autotune cache lookup failed; using static "
+                           "defaults", exc_info=True)
+            resolved = None
+        if resolved is None and mode == "eager":
+            try:
+                out = tune(kernel, n_events, n_trials, poly=poly)
+                resolved = (out["event_block"], out["trial_block"])
+            except Exception:  # noqa: BLE001
+                logger.warning("eager autotune failed; using static "
+                               "defaults", exc_info=True)
+                resolved = None
+    if resolved is None:
+        resolved = static_defaults(kernel)
+    eb = int(event_block) if event_block is not None else int(resolved[0])
+    tb = int(trial_block) if trial_block is not None else int(resolved[1])
+    return eb, tb
+
+
+# -- timing / tuning --------------------------------------------------------
+
+
+def sweep_candidates(kernel: str = "grid",
+                     n_events: int | None = None,
+                     n_trials: int | None = None,
+                     poly: bool = True,
+                     nharm: int = 2,
+                     candidates=None,
+                     repeats: int = 3,
+                     on_row=None) -> list[dict]:
+    """Time each (event_block, trial_block) candidate on the canonical
+    benchwork workload; returns one row dict per candidate (error rows for
+    candidates that fail to compile/fit — an OOM must not end the sweep).
+    """
+    from crimp_tpu.utils import benchwork
+
+    n_events = benchwork.AB_N_EVENTS if n_events is None else int(n_events)
+    n_trials = benchwork.AB_N_TRIALS if n_trials is None else int(n_trials)
+    if candidates is None:
+        candidates = DEFAULT_CANDIDATES
+    # the static default is always a candidate: the tuned result can then
+    # never be slower than the untuned install (acceptance criterion)
+    cand = list(dict.fromkeys([tuple(c) for c in candidates]
+                              + [static_defaults(kernel)]))
+    sec, freqs, f0, df = benchwork.ab_workload(n_events, n_trials)
+    rows = []
+    for eb, tb in cand:
+        try:
+            rate = benchwork.candidate_rate(
+                kernel, sec, freqs, f0, df, n_trials, nharm, eb, tb, poly,
+                repeats=repeats,
+            )
+            row = {"event_block": int(eb), "trial_block": int(tb),
+                   "trials_per_sec": round(float(rate), 1)}
+        except Exception as exc:  # noqa: BLE001 — record and continue
+            row = {"event_block": int(eb), "trial_block": int(tb),
+                   "error": f"{type(exc).__name__}: {str(exc)[:200]}"}
+        rows.append(row)
+        if on_row is not None:
+            on_row(row)
+    return rows
+
+
+def tune(kernel: str = "grid",
+         n_events: int | None = None,
+         n_trials: int | None = None,
+         poly: bool = True,
+         nharm: int = 2,
+         candidates=None,
+         repeats: int = 3,
+         persist: bool = True,
+         on_row=None) -> dict:
+    """Sweep the candidate grid, persist the winner, return it.
+
+    The measurement runs at the canonical benchwork scale CAPPED at the
+    requested problem size (timing a 1e8-event problem at full scale
+    inside a tuner would cost more than it saves); the cache key still
+    carries the caller's bucketed size, so a later resolve at that size
+    finds the winner with zero timing runs.
+    """
+    from crimp_tpu.utils import benchwork
+
+    n_events = benchwork.AB_N_EVENTS if n_events is None else int(n_events)
+    n_trials = benchwork.AB_N_TRIALS if n_trials is None else int(n_trials)
+    meas_events = min(n_events, benchwork.AB_N_EVENTS)
+    meas_trials = min(n_trials, benchwork.AB_N_TRIALS)
+    t0 = time.perf_counter()
+    rows = sweep_candidates(kernel, meas_events, meas_trials, poly, nharm,
+                            candidates, repeats, on_row)
+    timed = [r for r in rows if "trials_per_sec" in r]
+    if not timed:
+        raise RuntimeError(f"autotune sweep produced no timed candidates: {rows}")
+    winner = max(timed, key=lambda r: r["trials_per_sec"])
+    key = cache_key(kernel, poly, n_events, n_trials)
+    entry = {
+        "event_block": winner["event_block"],
+        "trial_block": winner["trial_block"],
+        "trials_per_sec": winner["trials_per_sec"],
+        "measured_events": meas_events,
+        "measured_trials": meas_trials,
+        "n_candidates": len(rows),
+        "tune_wall_s": round(time.perf_counter() - t0, 2),
+    }
+    if persist:
+        _store_entry(key, entry)
+        logger.info("autotune: cached %s -> (%d, %d) at %.0f trials/s",
+                    key, entry["event_block"], entry["trial_block"],
+                    entry["trials_per_sec"])
+    return {"key": key, "rows": rows, **entry}

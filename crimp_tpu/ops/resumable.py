@@ -105,11 +105,24 @@ class ResumableScan:
         self.poly = fasttrig.poly_trig_enabled(poly)
         self._fastpath = (search.uniform_grid(self.freqs) is not None
                           and search.grid_fastpath_enabled(self.nharm))
+        # Block tiling resolves through the autotuner ONCE per instance
+        # (explicit CRIMP_TPU_GRID_BLOCKS > cached winner > static
+        # defaults) and is pinned in the store fingerprint like the trig
+        # modes: every chunk of a store is computed under one tiling.
+        from crimp_tpu.ops import autotune
+
+        kernel = "grid" if self._fastpath else "general"
+        self._blocks = autotune.resolve_blocks(
+            kernel, len(self.times), min(len(self.freqs), self.chunk_trials),
+            poly=self.poly,
+        )
+        self._blocks_explicit = autotune.env_blocks_override(kernel) is not None
         self._numeric_mode = {
             "poly_trig": bool(self.poly),
             "grid_fastpath": bool(self._fastpath),
-            "grid_blocks": [search.GRID_EVENT_BLOCK, search.GRID_TRIAL_BLOCK],
+            "grid_blocks": list(self._blocks),
         }
+        self._times_dev = None  # lazy device-resident copy of the events
         self.store = pathlib.Path(store) if store is not None else None
         self.n_chunks = -(-len(self.freqs) // self.chunk_trials)
         if self.store is not None:
@@ -131,24 +144,34 @@ class ResumableScan:
                 # or an auto threshold changed between sessions): adopt the
                 # store's pinned modes so completed chunks stay usable —
                 # the result is coherent under the store's mode, which is
-                # what "resume" means. Anything else (different problem,
-                # different kernel version, different block tiling — the
-                # blocks are module constants this instance cannot adopt)
-                # still refuses.
+                # what "resume" means. Block tiling adopts the same way (a
+                # re-tuned autotuner winner is a preference drift, not a
+                # different problem — the instance pins whatever tiling the
+                # store was computed under). Anything else (different
+                # problem, different kernel version, an EXPLICIT env/ctor
+                # knob that conflicts) still refuses.
                 mode = existing.get("numeric_mode", {})
+                store_blocks = mode.get("grid_blocks")
+                blocks_ok = (
+                    isinstance(store_blocks, list) and len(store_blocks) == 2
+                    and all(isinstance(b, int) and b > 0 for b in store_blocks)
+                )
                 adoptable = (
                     {k: v for k, v in existing.items() if k != "numeric_mode"}
                     == {k: v for k, v in fp.items() if k != "numeric_mode"}
                     # a malformed/legacy manifest missing the pinned modes
                     # is not adoptable — there is no mode to adopt
                     and "poly_trig" in mode and "grid_fastpath" in mode
-                    and mode.get("grid_blocks") == self._numeric_mode["grid_blocks"]
-                    # an EXPLICIT constructor poly= that conflicts with the
-                    # store's pinned mode is a real mismatch, not a
-                    # preference drift — silently adopting would hand a
-                    # poly-validation run hw-trig chunks (or vice versa)
+                    and blocks_ok
+                    # an EXPLICIT constructor poly= (or CRIMP_TPU_GRID_BLOCKS
+                    # env) that conflicts with the store's pinned mode is a
+                    # real mismatch, not a preference drift — silently
+                    # adopting would hand a poly-validation run hw-trig
+                    # chunks (or a hand-pinned-tiling run re-tuned chunks)
                     and not (self._poly_explicit
                              and bool(mode.get("poly_trig")) != self.poly)
+                    and not (self._blocks_explicit
+                             and store_blocks != list(self._blocks))
                 )
                 if not adoptable:
                     raise ValueError(
@@ -166,6 +189,7 @@ class ResumableScan:
                 )
                 self.poly = bool(mode["poly_trig"])
                 self._fastpath = bool(mode["grid_fastpath"])
+                self._blocks = (int(store_blocks[0]), int(store_blocks[1]))
                 self._numeric_mode = mode
         else:
             self.store.mkdir(parents=True, exist_ok=True)
@@ -195,13 +219,36 @@ class ResumableScan:
             return None
         return pmesh.auto_mesh()
 
-    def _compute_chunk(self, i: int) -> np.ndarray:
-        """(n_fdot, k) Z^2 (or (1, k) H) rows for trial chunk i.
+    def _times_device(self):
+        """Events on device, uploaded ONCE per instance (the per-chunk
+        jnp.asarray re-upload was the resumable driver's transfer hotspot)."""
+        if self._times_dev is None:
+            import jax
+
+            self._times_dev = jax.device_put(self.times)
+        return self._times_dev
+
+    def _stream(self) -> bool:
+        """Whether fast-path chunks should take the double-buffered
+        streamed kernels (big event sets only; CRIMP_TPU_STREAM_MIN_EVENTS
+        governs, 0/off disables)."""
+        from crimp_tpu.ops import search
+
+        if not self._fastpath:
+            return False
+        threshold = search.stream_min_events()
+        return threshold is not None and len(self.times) >= threshold
+
+    def _compute_chunk_device(self, i: int):
+        """(n_fdot, k) Z^2 (or (1, k) H) rows for trial chunk i, still on
+        device (materialized by _compute_chunk / the pipelined run loop).
 
         Same dispatch as PeriodSearch: multi-device hosts auto-shard the
         event axis (psum combines), single-device hosts take the blockwise
         kernels; the uniform-grid fast path applies per chunk either way
-        (a chunk is a contiguous range of the full grid)."""
+        (a chunk is a contiguous range of the full grid). Above the
+        streaming threshold the fast-path kernels stream the event axis
+        chunkwise with double-buffered transfers (bit-identical sums)."""
         import jax.numpy as jnp
 
         from crimp_tpu.ops import search
@@ -209,6 +256,7 @@ class ResumableScan:
         lo = i * self.chunk_trials
         chunk = self.freqs[lo:lo + self.chunk_trials]
         poly = self.poly
+        eb, tb = self._blocks
         mesh = self._mesh(len(chunk))
         if mesh is not None:
             from crimp_tpu.parallel import mesh as pmesh
@@ -223,50 +271,86 @@ class ResumableScan:
                 rows = pmesh.z2_2d_sharded(self.times, chunk, self.fdots,
                                            self.nharm, mesh=mesh, poly=poly,
                                            use_fastpath=self._fastpath)
-            return np.asarray(rows)
+            return rows
         grid = search.uniform_grid(self.freqs)  # chunk grids inherit df
+        stream = self._stream()
         if self.statistic == "h":
-            if self._fastpath:
-                rows = search.h_power_grid(
+            if stream:
+                rows = search.h_power_grid_streamed(
                     self.times, float(chunk[0]), grid[1], len(chunk),
-                    self.nharm, poly=poly,
+                    self.nharm, event_block=eb, trial_block=tb, poly=poly,
+                )[None, :]
+            elif self._fastpath:
+                rows = search.h_power_grid(
+                    self._times_device(), float(chunk[0]), grid[1], len(chunk),
+                    self.nharm, event_block=eb, trial_block=tb, poly=poly,
                 )[None, :]
             else:
                 rows = search.h_power(
-                    jnp.asarray(self.times), jnp.asarray(chunk), self.nharm,
-                    poly=poly,
+                    self._times_device(), jnp.asarray(chunk), self.nharm,
+                    event_block=eb, trial_block=tb, poly=poly,
                 )[None, :]
+        elif stream:
+            rows = search.z2_power_2d_grid_streamed(
+                self.times, float(chunk[0]), grid[1], len(chunk),
+                self.fdots, self.nharm, event_block=eb, trial_block=tb,
+                poly=poly,
+            )
         elif self._fastpath:
             rows = search.z2_power_2d_grid(
-                jnp.asarray(self.times), float(chunk[0]), grid[1], len(chunk),
-                jnp.asarray(self.fdots), self.nharm, poly=poly,
+                self._times_device(), float(chunk[0]), grid[1], len(chunk),
+                jnp.asarray(self.fdots), self.nharm, event_block=eb,
+                trial_block=tb, poly=poly,
             )
         else:
             rows = search.z2_power_2d(
-                jnp.asarray(self.times), jnp.asarray(chunk),
-                jnp.asarray(self.fdots), self.nharm, poly=poly,
+                self._times_device(), jnp.asarray(chunk),
+                jnp.asarray(self.fdots), self.nharm, event_block=eb,
+                trial_block=tb, poly=poly,
             )
-        return np.asarray(rows)
+        return rows
+
+    def _compute_chunk(self, i: int) -> np.ndarray:
+        """Host-materialized rows for trial chunk i (sync entry point)."""
+        return np.asarray(self._compute_chunk_device(i))
+
+    def _finish_chunk(self, i: int, rows_dev, parts, progress) -> None:
+        """Materialize + atomically checkpoint one computed chunk."""
+        rows = np.asarray(rows_dev)
+        if self.store is not None:
+            tmp = self._chunk_path(i).with_suffix(".npy.tmp")
+            with open(tmp, "wb") as fh:  # np.save(path) would append .npy
+                np.save(fh, rows)
+            tmp.rename(self._chunk_path(i))
+        parts[i] = rows
+        if progress is not None:
+            progress(i, self.n_chunks)
 
     def run(self, progress=None) -> np.ndarray:
         """Compute all missing chunks (checkpointing each) and return the
         assembled (n_fdot, n_freq) power — or (n_freq,) for the 1-D scan.
         ``progress`` (optional callable) receives (chunk_index, n_chunks)
-        after each chunk completes."""
+        after each chunk completes.
+
+        The loop is pipelined: chunk i+1's kernels are DISPATCHED (async)
+        before chunk i's result is pulled to the host and checkpointed, so
+        the device computes while the host serializes — removing the
+        per-chunk host sync of the naive compute->save loop. Checkpoint
+        ordering is unchanged (chunk i is on disk before i+1's save
+        starts), so a kill mid-run leaves the same resumable state.
+        """
         done = set(self.done_chunks())
         parts: list[np.ndarray | None] = [None] * self.n_chunks
+        pending: tuple[int, object] | None = None
         for i in range(self.n_chunks):
             if i in done:
                 parts[i] = np.load(self._chunk_path(i))
                 continue
-            rows = self._compute_chunk(i)
-            if self.store is not None:
-                tmp = self._chunk_path(i).with_suffix(".npy.tmp")
-                with open(tmp, "wb") as fh:  # np.save(path) would append .npy
-                    np.save(fh, rows)
-                tmp.rename(self._chunk_path(i))
-            parts[i] = rows
-            if progress is not None:
-                progress(i, self.n_chunks)
+            rows_dev = self._compute_chunk_device(i)
+            if pending is not None:
+                self._finish_chunk(pending[0], pending[1], parts, progress)
+            pending = (i, rows_dev)
+        if pending is not None:
+            self._finish_chunk(pending[0], pending[1], parts, progress)
         power = np.concatenate(parts, axis=1)
         return power[0] if self._squeeze else power

@@ -38,7 +38,7 @@ all-f64 path for bit-level CPU parity checks.
 from __future__ import annotations
 
 import os
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -95,6 +95,27 @@ GRID_FASTPATH_MAX_NHARM = 20
 # Below this many (trial, event) pairs the dispatch/collective overhead of
 # auto-sharding outweighs the parallel win (PeriodSearch._mesh).
 MIN_SHARD_PAIRS = 1 << 22
+
+
+def resolve_blocks(kernel: str, n_events: int, n_trials: int,
+                   poly: bool = False,
+                   event_block: int | None = None,
+                   trial_block: int | None = None) -> tuple[int, int]:
+    """Resolve (event_block, trial_block) through the autotuner.
+
+    Thin lazy delegate to :func:`crimp_tpu.ops.autotune.resolve_blocks`
+    (imported inside the call — autotune lazily imports this module, so a
+    top-level import here would be circular during package init).
+    Precedence: explicit args > CRIMP_TPU_GRID_BLOCKS (grid kernels only)
+    > cached tuner winner > static defaults; CRIMP_TPU_AUTOTUNE=0 skips
+    the cache entirely.
+    """
+    from crimp_tpu.ops import autotune
+
+    return autotune.resolve_blocks(
+        kernel, n_events, n_trials, poly=poly,
+        event_block=event_block, trial_block=trial_block,
+    )
 
 
 def grid_fastpath_enabled(nharm: int, override: bool | None = None) -> bool:
@@ -367,16 +388,22 @@ def z2_power_grid(
     df: float,
     n_freq: int,
     nharm: int = 2,
-    event_block: int = GRID_EVENT_BLOCK,
-    trial_block: int = GRID_TRIAL_BLOCK,
+    event_block: int | None = None,
+    trial_block: int | None = None,
     poly: bool = False,
 ) -> jax.Array:
-    """Z^2_n over the uniform grid f0 + j*df (fast path; see above)."""
+    """Z^2_n over the uniform grid f0 + j*df (fast path; see above).
+
+    Blocks default to the autotuner resolution (resolve_blocks): explicit
+    arguments and CRIMP_TPU_GRID_BLOCKS stay hard overrides, a cached
+    tuner winner is used when present, static defaults otherwise.
+    """
+    n = np.shape(times)[0]
+    eb, tb = resolve_blocks("grid", n, n_freq, poly, event_block, trial_block)
     c, s = harmonic_sums_uniform(
-        jnp.asarray(times), f0, df, n_freq, nharm, event_block, trial_block,
-        poly=poly,
+        jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
     )
-    return jnp.sum(z2_from_sums(c, s, np.shape(times)[0]), axis=0)
+    return jnp.sum(z2_from_sums(c, s, n), axis=0)
 
 
 def h_power_grid(
@@ -385,16 +412,17 @@ def h_power_grid(
     df: float,
     n_freq: int,
     nharm: int = 20,
-    event_block: int = GRID_EVENT_BLOCK,
-    trial_block: int = GRID_TRIAL_BLOCK,
+    event_block: int | None = None,
+    trial_block: int | None = None,
     poly: bool = False,
 ) -> jax.Array:
     """H-test over the uniform grid f0 + j*df (fast path)."""
+    n = np.shape(times)[0]
+    eb, tb = resolve_blocks("grid", n, n_freq, poly, event_block, trial_block)
     c, s = harmonic_sums_uniform(
-        jnp.asarray(times), f0, df, n_freq, nharm, event_block, trial_block,
-        poly=poly,
+        jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
     )
-    z2_cum = jnp.cumsum(z2_from_sums(c, s, np.shape(times)[0]), axis=0)
+    z2_cum = jnp.cumsum(z2_from_sums(c, s, n), axis=0)
     penalties = 4.0 * jnp.arange(nharm, dtype=jnp.float64)[:, None]
     return jnp.max(z2_cum - penalties, axis=0)
 
@@ -471,7 +499,6 @@ def harmonic_sums_uniform_2d(
     return c_all, s_all
 
 
-@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block", "trial_block", "poly"))
 def z2_power_2d_grid(
     times: jax.Array,
     f0: float,
@@ -479,21 +506,273 @@ def z2_power_2d_grid(
     n_freq: int,
     fdots: jax.Array,
     nharm: int = 2,
-    event_block: int = GRID_EVENT_BLOCK,
-    trial_block: int = GRID_TRIAL_BLOCK,
+    event_block: int | None = None,
+    trial_block: int | None = None,
     poly: bool = False,
 ) -> jax.Array:
     """Z^2_n over the (fdot x uniform-frequency) grid -> (n_fdot, n_freq).
 
     Built on harmonic_sums_uniform_2d: the per-tile f64 frequency rows are
     shared across fdots and the per-fdot f64 quadratic rows are shared
-    across tiles. ``fdots`` are SIGNED Hz/s as in z2_power_2d.
+    across tiles. ``fdots`` are SIGNED Hz/s as in z2_power_2d. A plain
+    (non-jitted) wrapper so blocks resolve through the autotuner per call;
+    the heavy kernel underneath stays jitted.
     """
+    times = jnp.asarray(times)
     n = times.shape[0]
+    eb, tb = resolve_blocks("grid", int(n), int(n_freq), poly,
+                            event_block, trial_block)
     c, s = harmonic_sums_uniform_2d(
         times, f0, df, n_freq, jnp.asarray(fdots, dtype=jnp.float64), nharm,
-        event_block, trial_block, poly=poly,
+        eb, tb, poly=poly,
     )
+    return jnp.sum(z2_from_sums(c, s, n), axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered streaming (host -> device overlap)
+# ---------------------------------------------------------------------------
+
+# Events per streamed chunk (rounded down to an event_block multiple).
+# 2^21 f64 times = 16 MiB per transfer: big enough to amortize dispatch,
+# small enough that the next chunk's host->device copy hides entirely
+# under the current chunk's compute.
+STREAM_EVENT_CHUNK = 1 << 21
+
+
+def stream_min_events() -> int | None:
+    """Event count above which the resumable driver streams chunks.
+
+    CRIMP_TPU_STREAM_MIN_EVENTS: unset -> 2^22; "0"/"off" disables
+    streaming; otherwise an integer threshold.
+    """
+    env = os.environ.get("CRIMP_TPU_STREAM_MIN_EVENTS", "").strip().lower()
+    if env in ("0", "off", "false", "never"):
+        return None
+    if not env:
+        return 1 << 22
+    try:
+        return int(env)
+    except ValueError:
+        raise ValueError(
+            f"CRIMP_TPU_STREAM_MIN_EVENTS={env!r} not recognized; expected "
+            "an integer event count or 0/off"
+        ) from None
+
+
+@lru_cache(maxsize=None)
+def _grid_stream_update(nharm: int, n_tiles: int, event_block: int,
+                        trial_block: int, poly: bool, donate: bool):
+    """Jitted carry update for one streamed chunk of the 1-D grid kernel.
+
+    The body replays harmonic_sums_uniform's per-tile scan EXACTLY — same
+    per-block phase math, same f64 accumulation order, with the carry
+    threaded across chunks instead of initialized to zero — so the
+    streamed result is bit-identical to the monolithic kernel. Donating
+    the accumulators lets XLA update them in place (skipped on CPU where
+    donation is unimplemented and only warns).
+    """
+
+    def update(c, s, chunk_times, n_valid, f0, df, fdot):
+        time_blocks = chunk_times.reshape(-1, event_block)
+        w = (jnp.arange(chunk_times.shape[0]) < n_valid).astype(jnp.float64)
+        weight_blocks = w.reshape(-1, event_block)
+        b_blocks = fasttrig.centered_frac(df * time_blocks).astype(jnp.float32)
+        j_lo = jnp.arange(trial_block, dtype=jnp.float32)
+
+        def one_tile(args):
+            tile_idx, c0, s0 = args
+            f_tile = f0 + (tile_idx * trial_block) * df
+
+            def step(carry, blk):
+                t_blk, w_blk, b_blk = blk
+                base = f_tile * t_blk + (0.5 * fdot) * t_blk**2
+                cb = fasttrig.centered_frac(base).astype(jnp.float32)
+                phase32 = cb[None, :] + j_lo[:, None] * b_blk[None, :]
+                ck, sk = _harmonic_sums_cycles(
+                    phase32, w_blk[None, :].astype(jnp.float32), nharm,
+                    jnp.float32, poly,
+                )
+                return (carry[0] + ck, carry[1] + sk), None
+
+            (c1, s1), _ = jax.lax.scan(
+                step, (c0, s0), (time_blocks, weight_blocks, b_blocks)
+            )
+            return c1, s1
+
+        return jax.lax.map(
+            one_tile, (jnp.arange(n_tiles, dtype=jnp.float64), c, s)
+        )
+
+    return jax.jit(update, donate_argnums=(0, 1) if donate else ())
+
+
+@lru_cache(maxsize=None)
+def _grid2d_stream_update(nharm: int, n_tiles: int, event_block: int,
+                          trial_block: int, poly: bool, donate: bool):
+    """Jitted carry update for one streamed chunk of the 2-D grid kernel
+    (same replay-the-monolithic-scan-body contract as _grid_stream_update)."""
+
+    def update(c, s, chunk_times, n_valid, f0, df, fdots):
+        time_blocks = chunk_times.reshape(-1, event_block)
+        w = (jnp.arange(chunk_times.shape[0]) < n_valid).astype(jnp.float64)
+        weight_blocks = w.reshape(-1, event_block)
+        b_blocks = fasttrig.centered_frac(df * time_blocks).astype(jnp.float32)
+        j_lo = jnp.arange(trial_block, dtype=jnp.float32)
+        f_tiles = f0 + (jnp.arange(n_tiles, dtype=jnp.float64) * trial_block) * df
+        fd = jnp.asarray(fdots, dtype=jnp.float64)
+
+        def step(carry, blk):
+            t_blk, w_blk, b_blk = blk
+            row_t = fasttrig.centered_frac(
+                f_tiles[:, None] * t_blk[None, :]).astype(jnp.float32)
+            row_q = fasttrig.centered_frac(
+                (0.5 * fd)[:, None] * (t_blk * t_blk)[None, :]).astype(jnp.float32)
+            w32 = w_blk.astype(jnp.float32)
+
+            def per_fdot(q_row):
+                def per_tile(t_row):
+                    phase32 = (t_row + q_row)[None, :] + j_lo[:, None] * b_blk[None, :]
+                    return _harmonic_sums_cycles(
+                        phase32, w32[None, :], nharm, jnp.float32, poly
+                    )
+                return jax.lax.map(per_tile, row_t)
+
+            ck, sk = jax.lax.map(per_fdot, row_q)
+            return (carry[0] + ck, carry[1] + sk), None
+
+        (c1, s1), _ = jax.lax.scan(
+            step, (c, s), (time_blocks, weight_blocks, b_blocks)
+        )
+        return c1, s1
+
+    return jax.jit(update, donate_argnums=(0, 1) if donate else ())
+
+
+def _stream_chunks(times: np.ndarray, event_block: int, event_chunk: int):
+    """Host-side chunk plan: [(padded_chunk, n_valid), ...].
+
+    Chunk boundaries are event_block multiples and the tail is padded only
+    to the next event_block multiple (not to the full chunk), so every
+    per-block computation — including the padded tail block — is the same
+    one the monolithic kernel runs. Every chunk carries at least TWO
+    event blocks (a 1-block remainder merges into the previous chunk):
+    XLA unrolls a length-1 scan and fuses its f32 body differently from
+    the loop form, which would break bit-identity with the monolithic
+    kernel's multi-block scan.
+    """
+    n = len(times)
+    n_blocks = -(-n // event_block)
+    bpc = max(2, event_chunk // event_block)  # blocks per chunk
+    starts = list(range(0, n_blocks, bpc))
+    if len(starts) > 1 and n_blocks - starts[-1] == 1:
+        starts.pop()
+    out = []
+    for i, b0 in enumerate(starts):
+        b1 = n_blocks if i + 1 == len(starts) else starts[i + 1]
+        part = times[b0 * event_block:min(n, b1 * event_block)]
+        n_valid = len(part)
+        padded_len = (b1 - b0) * event_block
+        if padded_len != n_valid:
+            part = np.pad(part, (0, padded_len - n_valid))
+        out.append((part, n_valid))
+    return out
+
+
+def _streamed_uniform_sums(times, f0, df, n_freq, nharm, event_block,
+                           trial_block, poly, fdots=None, event_chunk=None):
+    """Double-buffered driver shared by the streamed grid kernels.
+
+    The host->device transfer of chunk i+1 is issued (async device_put)
+    BEFORE the carry update of chunk i is dispatched, so on accelerators
+    the copy runs under the compute and the per-chunk host sync of the
+    naive loop disappears. Returns the same (c, s) sums as the monolithic
+    harmonic_sums_uniform / _2d calls, bit-for-bit.
+    """
+    times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
+    n_tiles = -(-n_freq // trial_block)
+    chunk = STREAM_EVENT_CHUNK if event_chunk is None else int(event_chunk)
+    plan = _stream_chunks(times, event_block, chunk)
+    if len(plan) == 1:
+        # one chunk IS the whole problem: delegate to the monolithic
+        # kernel (trivially bit-identical, and avoids compiling a second
+        # program for nothing — including the sub-2-block case where the
+        # carry update's scan could not replay the monolithic loop form)
+        dev_times = jnp.asarray(times)
+        if fdots is None:
+            return harmonic_sums_uniform(
+                dev_times, f0, df, n_freq, nharm, event_block, trial_block,
+                poly=poly)
+        return harmonic_sums_uniform_2d(
+            dev_times, f0, df, n_freq, jnp.asarray(fdots, dtype=jnp.float64),
+            nharm, event_block, trial_block, poly=poly)
+    donate = jax.default_backend() != "cpu"
+    if fdots is None:
+        update = _grid_stream_update(nharm, n_tiles, event_block,
+                                     trial_block, poly, donate)
+        carry_shape = (n_tiles, nharm, trial_block)
+        extra = (0.0,)
+    else:
+        fdots = jnp.asarray(fdots, dtype=jnp.float64)
+        update = _grid2d_stream_update(nharm, n_tiles, event_block,
+                                       trial_block, poly, donate)
+        carry_shape = (int(fdots.shape[0]), n_tiles, nharm, trial_block)
+        extra = (fdots,)
+    c = jnp.zeros(carry_shape, dtype=jnp.float64)
+    s = jnp.zeros(carry_shape, dtype=jnp.float64)
+    dev = jax.device_put(plan[0][0])
+    for i, (_, n_valid) in enumerate(plan):
+        nxt = jax.device_put(plan[i + 1][0]) if i + 1 < len(plan) else None
+        c, s = update(c, s, dev, n_valid, f0, df, *extra)
+        dev = nxt
+    if fdots is None:
+        c_all = jnp.moveaxis(c, 1, 0).reshape(nharm, -1)[:, :n_freq]
+        s_all = jnp.moveaxis(s, 1, 0).reshape(nharm, -1)[:, :n_freq]
+    else:
+        n_fdot = carry_shape[0]
+        c_all = jnp.moveaxis(c, 2, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
+        s_all = jnp.moveaxis(s, 2, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
+    return c_all, s_all
+
+
+def z2_power_grid_streamed(
+    times, f0: float, df: float, n_freq: int, nharm: int = 2,
+    event_block: int | None = None, trial_block: int | None = None,
+    poly: bool = False, event_chunk: int | None = None,
+) -> jax.Array:
+    """z2_power_grid with double-buffered host->device event streaming."""
+    n = np.shape(times)[0]
+    eb, tb = resolve_blocks("grid", n, n_freq, poly, event_block, trial_block)
+    c, s = _streamed_uniform_sums(times, f0, df, n_freq, nharm, eb, tb, poly,
+                                  event_chunk=event_chunk)
+    return jnp.sum(z2_from_sums(c, s, n), axis=0)
+
+
+def h_power_grid_streamed(
+    times, f0: float, df: float, n_freq: int, nharm: int = 20,
+    event_block: int | None = None, trial_block: int | None = None,
+    poly: bool = False, event_chunk: int | None = None,
+) -> jax.Array:
+    """h_power_grid with double-buffered host->device event streaming."""
+    n = np.shape(times)[0]
+    eb, tb = resolve_blocks("grid", n, n_freq, poly, event_block, trial_block)
+    c, s = _streamed_uniform_sums(times, f0, df, n_freq, nharm, eb, tb, poly,
+                                  event_chunk=event_chunk)
+    z2_cum = jnp.cumsum(z2_from_sums(c, s, n), axis=0)
+    penalties = 4.0 * jnp.arange(nharm, dtype=jnp.float64)[:, None]
+    return jnp.max(z2_cum - penalties, axis=0)
+
+
+def z2_power_2d_grid_streamed(
+    times, f0: float, df: float, n_freq: int, fdots, nharm: int = 2,
+    event_block: int | None = None, trial_block: int | None = None,
+    poly: bool = False, event_chunk: int | None = None,
+) -> jax.Array:
+    """z2_power_2d_grid with double-buffered host->device event streaming."""
+    n = np.shape(times)[0]
+    eb, tb = resolve_blocks("grid", n, n_freq, poly, event_block, trial_block)
+    c, s = _streamed_uniform_sums(times, f0, df, n_freq, nharm, eb, tb, poly,
+                                  fdots=fdots, event_chunk=event_chunk)
     return jnp.sum(z2_from_sums(c, s, n), axis=1)
 
 
@@ -581,6 +860,11 @@ class PeriodSearch:
             return None
         return uniform_grid(self.freq)
 
+    def _general_blocks(self) -> tuple[int, int]:
+        """Autotuned (event_block, trial_block) for the general kernels."""
+        return resolve_blocks("general", len(self.time), len(self.freq),
+                              self._poly())
+
     def _mesh(self, n_pairs: int | None = None):
         """Device mesh for auto-sharding, or None for the single-device path."""
         if n_pairs is None:
@@ -607,9 +891,10 @@ class PeriodSearch:
                 z2_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm,
                               poly=self._poly())
             )
+        eb, tb = self._general_blocks()
         return np.asarray(
             z2_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm,
-                     poly=self._poly())
+                     event_block=eb, trial_block=tb, poly=self._poly())
         )
 
     def htest(self) -> np.ndarray:
@@ -628,9 +913,10 @@ class PeriodSearch:
                 h_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm,
                              poly=self._poly())
             )
+        eb, tb = self._general_blocks()
         return np.asarray(
             h_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm,
-                    poly=self._poly())
+                    event_block=eb, trial_block=tb, poly=self._poly())
         )
 
     def twod_ztest(self, freq_dot):
@@ -658,12 +944,15 @@ class PeriodSearch:
                 )
             )
         else:
+            eb, tb = self._general_blocks()
             power = np.asarray(
                 z2_power_2d(
                     self._centered(),
                     jnp.asarray(self.freq),
                     jnp.asarray(signed),
                     self.nbrHarm,
+                    event_block=eb,
+                    trial_block=tb,
                     poly=self._poly(),
                 )
             )

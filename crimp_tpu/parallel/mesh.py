@@ -59,6 +59,7 @@ from crimp_tpu.ops.search import (
     _blocked_trial_sums,
     grid_fastpath_enabled,
     harmonic_sums_uniform_2d,
+    resolve_blocks,
     uniform_grid,
     z2_from_sums,
 )
@@ -253,19 +254,24 @@ def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath,
     if grid is not None:
         f0, df = grid
         n_freq_pad = -(-n_freq // tr_size) * tr_size
+        # Per-SHARD workload is what each device tiles, so the autotuner is
+        # consulted at shard scale and _fit_block then shrinks the winner
+        # to small inputs exactly as it always shrank the static default.
+        g_eb, g_tb = resolve_blocks("grid", ev_per_shard, tr_per_shard, poly)
         c, s = _sharded_sums_grid(
             jnp.asarray(t_pad), jnp.asarray(w_pad), f0, df, n_freq_pad, fd, nharm, mesh,
-            event_block=_fit_block(GRID_EVENT_BLOCK, ev_per_shard),
-            trial_block=_fit_block(GRID_TRIAL_BLOCK, tr_per_shard),
+            event_block=_fit_block(g_eb, ev_per_shard),
+            trial_block=_fit_block(g_tb, tr_per_shard),
             poly=poly,
         )
     else:
         f_pad, _ = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
+        d_eb, d_tb = resolve_blocks("general", ev_per_shard, tr_per_shard, poly)
         c, s = _sharded_sums_general(
             jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad), fd,
             nharm, mesh, trig_dtype=trig_dtype,
-            event_block=_fit_block(DEFAULT_EVENT_BLOCK, ev_per_shard),
-            trial_block=_fit_block(DEFAULT_TRIAL_BLOCK, tr_per_shard),
+            event_block=_fit_block(d_eb, ev_per_shard),
+            trial_block=_fit_block(d_tb, tr_per_shard),
             poly=poly,
         )
     return c[:, :, :n_freq], s[:, :, :n_freq]

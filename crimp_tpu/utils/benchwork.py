@@ -41,3 +41,35 @@ def best_rate(fn, n_trials: int, repeats: int = 3) -> float:
         fn().block_until_ready()
         best = min(best, time.perf_counter() - t0)
     return n_trials / best
+
+
+def candidate_rate(kernel: str, sec, freqs, f0, df, n_trials: int,
+                   nharm: int, event_block: int, trial_block: int,
+                   poly: bool, repeats: int = 3) -> float:
+    """trials/s of ONE (event_block, trial_block) candidate on the A/B
+    problem — the measurement primitive the block autotuner ranks with.
+
+    ``kernel`` selects the variant family being tuned: "grid" times the
+    uniform-grid fast path (harmonic_sums_uniform, the same jitted core
+    z2/h _power_grid call), "general" the arbitrary-frequency blockwise
+    kernel. Returns a device-synchronized rate via best_rate.
+    """
+    import jax.numpy as jnp
+
+    from crimp_tpu.ops import search
+
+    times = jnp.asarray(sec)
+    # the kernels return a (c, s) pair; best_rate syncs on its return
+    # value, so hand it one array (syncing either syncs the whole computation)
+    if kernel == "grid":
+        fn = lambda: search.harmonic_sums_uniform(  # noqa: E731
+            times, float(f0), float(df), int(n_trials), nharm,
+            event_block=event_block, trial_block=trial_block, poly=poly)[0]
+    elif kernel == "general":
+        freqs_dev = jnp.asarray(freqs)
+        fn = lambda: search.harmonic_sums_1d(  # noqa: E731
+            times, freqs_dev, nharm, event_block=event_block,
+            trial_block=trial_block, poly=poly)[0]
+    else:
+        raise ValueError(f"unknown kernel variant {kernel!r}")
+    return best_rate(fn, int(n_trials), repeats=repeats)

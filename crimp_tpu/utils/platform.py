@@ -11,6 +11,9 @@ keeps that workaround in one place for every script that needs a
 
 from __future__ import annotations
 
+import os
+import pathlib
+
 
 def add_cpu_flag(parser) -> None:
     """Add the standard ``--cpu`` dry-run flag to an argparse parser."""
@@ -27,3 +30,48 @@ def force_cpu_platform() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def compilation_cache_dir() -> pathlib.Path | None:
+    """Resolved persistent-compile-cache dir, or None when disabled.
+
+    ``CRIMP_TPU_COMPILE_CACHE``: unset/empty -> default
+    ``$XDG_CACHE_HOME/crimp_tpu/jax_cache``; ``0/off/none`` -> disabled;
+    anything else is used as the directory path.
+    """
+    env = os.environ.get("CRIMP_TPU_COMPILE_CACHE", "").strip()
+    if env.lower() in ("0", "off", "none", "false"):
+        return None
+    if env:
+        return pathlib.Path(env)
+    base = os.environ.get("XDG_CACHE_HOME", "").strip() or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return pathlib.Path(base) / "crimp_tpu" / "jax_cache"
+
+
+def configure_compilation_cache() -> pathlib.Path | None:
+    """Point jax's persistent compilation cache at our directory.
+
+    Config-only: sets jax.config values without initializing a backend
+    (``import crimp_tpu`` must stay side-effect-free w.r.t. device
+    acquisition — the relay-window scripts rely on that). Every scarce
+    relay window was burning minutes recompiling identical kernels; with
+    this cache a second cold process retrieves them from disk instead.
+    The min-compile-time floor defaults to 0 so even the sub-second CPU
+    test kernels round-trip (``CRIMP_TPU_COMPILE_CACHE_MIN_S`` raises it
+    for installs that only want the expensive TPU binaries persisted).
+    """
+    target = compilation_cache_dir()
+    if target is None:
+        return None
+    import jax
+
+    try:
+        target.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(target))
+        min_s = float(os.environ.get("CRIMP_TPU_COMPILE_CACHE_MIN_S", "0") or 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", min_s)
+    except (OSError, ValueError, AttributeError):
+        return None
+    return target

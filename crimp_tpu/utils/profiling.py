@@ -60,6 +60,68 @@ def reset_kernel_times() -> None:
     _KERNEL_TIMES.clear()
 
 
+_COMPILE_EVENTS: dict[str, int] = {}
+_COMPILE_DURATIONS: dict[str, float] = {}
+_LISTENERS_INSTALLED = False
+
+
+def install_compile_listeners() -> bool:
+    """Subscribe to jax's monitoring stream for compile/cache telemetry.
+
+    Counts ``/jax/compilation_cache/{cache_hits,cache_misses,...}`` events
+    and accumulates compile/retrieval durations, so ``compile_counters()``
+    can report persistent-cache effectiveness without parsing logs. Uses
+    ``jax._src.monitoring`` (no public alias in jax 0.4.x) — guarded so a
+    jax upgrade that moves it degrades to "no counters", never to a
+    broken import. Idempotent; installing is config-only (no backend).
+    """
+    global _LISTENERS_INSTALLED
+    if _LISTENERS_INSTALLED:
+        return True
+    try:
+        from jax._src import monitoring
+    except ImportError:
+        return False
+
+    def _on_event(event: str, **kw) -> None:
+        if event.startswith("/jax/compilation_cache/"):
+            key = event.rsplit("/", 1)[-1]
+            _COMPILE_EVENTS[key] = _COMPILE_EVENTS.get(key, 0) + 1
+
+    def _on_duration(event: str, duration: float, **kw) -> None:
+        if event.startswith(("/jax/compilation_cache/", "/jax/core/compile/")):
+            key = event.rsplit("/", 1)[-1]
+            _COMPILE_DURATIONS[key] = _COMPILE_DURATIONS.get(key, 0.0) + duration
+
+    try:
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:  # noqa: BLE001 — telemetry must never break import
+        return False
+    _LISTENERS_INSTALLED = True
+    return True
+
+
+def compile_counters() -> dict:
+    """Cache hit/miss counts + accumulated compile durations (seconds)."""
+    return {
+        "cache_hits": _COMPILE_EVENTS.get("cache_hits", 0),
+        "cache_misses": _COMPILE_EVENTS.get("cache_misses", 0),
+        "events": dict(_COMPILE_EVENTS),
+        "backend_compile_s": round(
+            _COMPILE_DURATIONS.get("backend_compile_duration", 0.0), 4),
+        "cache_retrieval_s": round(
+            _COMPILE_DURATIONS.get("cache_retrieval_time_sec", 0.0), 4),
+        "compile_time_saved_s": round(
+            _COMPILE_DURATIONS.get("compile_time_saved_sec", 0.0), 4),
+    }
+
+
+def reset_compile_counters() -> None:
+    _COMPILE_EVENTS.clear()
+    _COMPILE_DURATIONS.clear()
+
+
 @contextlib.contextmanager
 def trace(trace_dir: str | None = None):
     """jax.profiler trace context; no-op when no directory is configured.

@@ -19,6 +19,42 @@ import re
 import sys
 
 
+def _reconstruct_from_sidecar(out: pathlib.Path) -> dict | None:
+    # Reconstruct a wedged/fallback bench from the per-sub-measurement
+    # sidecar (bench.py emit_partial). Newest sidecar only — never stitch
+    # rows from different runs/files into one frankenstein record (bench.py
+    # also truncates its sidecar at start for the same reason).
+    partial = {}
+    sidecars = sorted(out.glob("bench_partial*.jsonl"),
+                      key=lambda p: p.stat().st_mtime, reverse=True)
+    if sidecars:
+        # newest ONLY — an empty newest sidecar means "nothing of the
+        # current run completed", not "borrow the previous run's rows"
+        for line in sidecars[0].read_text().splitlines():
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            partial[row.pop("stage", "?")] = row
+    # the carry row is a re-print of the previous round, not a measurement
+    partial.pop("carry", None)
+    if "final" in partial:
+        return partial["final"]
+    if partial:
+        bench = {
+            "platform": partial.get("platform", {}).get("platform"),
+            "value": partial.get("toas", {}).get("toas_per_sec"),
+            "z2_trials_per_sec_poly": partial.get("z2", {}).get(
+                "trials_per_sec_poly"),
+            "z2_trials_per_sec_pallas": partial.get("z2", {}).get(
+                "trials_per_sec_pallas"),
+        }
+        print(f"reconstructed {sum(v is not None for v in bench.values())} "
+              "fields from the partial sidecar", file=sys.stderr)
+        return bench
+    return None
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     out = pathlib.Path(argv[0] if argv else "onchip_results")
@@ -32,41 +68,32 @@ def main(argv: list[str] | None = None) -> int:
             line = line.strip()
             if line.startswith("{"):
                 try:
-                    bench = json.loads(line)
-                except json.JSONDecodeError:
-                    pass
-    if not bench:
-        # the bench process wedged before its final line: reconstruct what
-        # DID complete from the per-sub-measurement sidecar (bench.py
-        # emit_partial). Newest sidecar only — never stitch rows from
-        # different runs/files into one frankenstein record (bench.py also
-        # truncates its sidecar at start for the same reason).
-        partial = {}
-        sidecars = sorted(out.glob("bench_partial*.jsonl"),
-                          key=lambda p: p.stat().st_mtime, reverse=True)
-        if sidecars:
-            # newest ONLY — an empty newest sidecar means "nothing of the
-            # current run completed", not "borrow the previous run's rows"
-            for line in sidecars[0].read_text().splitlines():
-                try:
-                    row = json.loads(line)
+                    record = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                partial[row.pop("stage", "?")] = row
-        if "final" in partial:
-            bench = partial["final"]
-        elif partial:
-            bench = {
-                "platform": partial.get("platform", {}).get("platform"),
-                "value": partial.get("toas", {}).get("toas_per_sec"),
-                "z2_trials_per_sec_poly": partial.get("z2", {}).get(
-                    "trials_per_sec_poly"),
-                "z2_trials_per_sec_pallas": partial.get("z2", {}).get(
-                    "trials_per_sec_pallas"),
-            }
-            print(f"bench.log had no final JSON; reconstructed "
-                  f"{sum(v is not None for v in bench.values())} fields from "
-                  "the partial sidecar", file=sys.stderr)
+                # bench.py prints a carried-forward copy of the PREVIOUS
+                # round's record before probing the platform, so an
+                # externally-killed bench still leaves a parseable line.
+                # That line is a re-print, not a measurement: it must never
+                # be promoted to (or ratcheted into) the on-chip guard.
+                if isinstance(record, dict) and record.get("carried"):
+                    continue
+                bench = record
+    if not bench:
+        # the bench process wedged before its final line: use what DID
+        # complete per the sidecar
+        bench = _reconstruct_from_sidecar(out)
+    elif bench.get("platform") != "tpu":
+        # the final record ran on a fallback platform (e.g. the relay died
+        # mid-session and a retry completed on CPU), but the newest sidecar
+        # may hold rows that DID run on the chip — those rows, not the CPU
+        # final line, are the session's on-chip result
+        recon = _reconstruct_from_sidecar(out)
+        if recon and recon.get("platform") == "tpu":
+            print(f"final bench record platform is {bench.get('platform')!r}; "
+                  "adopting the tpu rows from the partial sidecar instead",
+                  file=sys.stderr)
+            bench = recon
     if not bench:
         print("no JSON in bench.log nor bench_partial*.jsonl", file=sys.stderr)
         return 1

@@ -15,10 +15,12 @@ RESULTS="$OUT/results_late.jsonl"
 run() {
     local name="$1"; shift
     local tmo="$1"; shift
-    if [ -f "$OUT/done_late_$name" ]; then
+    if [ -f "$OUT/done_late_$name" ] || [ -f "$OUT/done_$name" ]; then
         # a watcher relaunch of the same outdir must not re-burn serialized
-        # chip time on stages already green (same policy as
-        # onchip_session.sh's done_$name markers)
+        # chip time on stages already green — including stages the FULL
+        # session already ran (watch_relay degrades full -> late in the
+        # same outdir; round 5's late bench re-burned 40 min replaying a
+        # bench the full session had already recorded under done_bench)
         echo "{\"stage\": \"$name\", \"rc\": 0, \"cached\": true}" >> "$RESULTS"
         echo "=== [late:$name] SKIPPED: green in a previous attempt ===" | tee -a "$OUT/session.log"
         return 0
@@ -51,8 +53,11 @@ run round_guard 1100 env CRIMP_TPU_RUN_TPU_TESTS=1 \
 run bench 2400 env CRIMP_TPU_BENCH_PROBE_DEADLINE_S=600 \
     CRIMP_TPU_BENCH_PARTIAL="$OUT/bench_partial_late.jsonl" python bench.py
 # extract_rates reads $OUT/bench.log; promote the late log when green so
-# the ratchet sees the uncontended numbers (attempt 1's log is in git)
-grep -q '"stage": "bench", "rc": 0' "$RESULTS" && cp "$OUT/bench_late.log" "$OUT/bench.log"
+# the ratchet sees the uncontended numbers (attempt 1's log is in git).
+# A cached-green bench has no late log — the promoted copy already exists.
+if grep -q '"stage": "bench", "rc": 0' "$RESULTS" && [ -f "$OUT/bench_late.log" ]; then
+    cp "$OUT/bench_late.log" "$OUT/bench.log"
+fi
 
 python scripts/extract_rates.py "$OUT" 2>&1 | tee -a "$OUT/session.log"
 echo "{\"stage\": \"extract_rates\", \"rc\": ${PIPESTATUS[0]}}" >> "$RESULTS"

@@ -51,10 +51,23 @@ ensure_healthy() {
     # official record), wait it out: probe every 5 min, 14 rounds. Worst
     # case each round is 300 s sleep + a probe that hangs its full 300 s
     # timeout, so the real bound is ~2.3 h, not 70 min.
+    #
+    # CRIMP_TPU_SESSION_DEADLINE bounds the wait: a probe round costs up to
+    # 600 s (300 s sleep + 300 s hanging probe), so once that would overrun
+    # the deadline, stop — the chip must be free at the deadline, and
+    # burning the remaining window sleeping here would also starve
+    # extract_rates of any chance to run (round 5 lost the whole window to
+    # exactly this recovery loop).
     health_ok && return 0
     echo "--- relay unhealthy at $(date -u +%H:%M:%S); waiting for grant expiry ---" \
         | tee -a "$OUT/session.log"
     for _ in $(seq 1 14); do
+        if [ -n "${CRIMP_TPU_SESSION_DEADLINE:-}" ] \
+            && [ $(( $(date +%s) + 600 )) -gt "$CRIMP_TPU_SESSION_DEADLINE" ]; then
+            echo "--- abandoning relay recovery: next probe round would overrun session deadline ---" \
+                | tee -a "$OUT/session.log"
+            return 1
+        fi
         sleep 300
         if health_ok; then
             echo "--- relay recovered at $(date -u +%H:%M:%S) ---" | tee -a "$OUT/session.log"

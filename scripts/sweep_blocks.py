@@ -1,14 +1,18 @@
-"""On-chip block-size sweep for the uniform-grid Z^2 fast path.
+"""On-chip block-size sweep — a thin CLI over crimp_tpu.ops.autotune.
 
-The roofline (docs/performance.md "Z^2 roofline") puts the poly-trig path
-at ~34% of VPU peak and attributes the gap to scheduling, not math; the
-current GRID_EVENT_BLOCK/GRID_TRIAL_BLOCK (2^15 / 512) were tuned BEFORE
-poly trig landed, so the optimum may have moved (VERDICT r3 item 6). This
-sweeps both knobs at bench scale (8e5 events x 1e5 trials, nharm 2, poly
-trig) plus the Pallas kernel's tile knobs, and prints one JSON line per
-point — paste the winner into ops/search.py / docs/performance.md.
+The sweep logic (candidate grid, canonical A/B workload, winner
+selection) lives in the library now: ``autotune.tune`` times the
+candidates and PERSISTS the winner in the fingerprinted autotune cache,
+so the library's kernels pick it up on the next call with no code edit
+(the old paste-the-winner-into-ops/search.py workflow is retired; see
+docs/performance.md). This script keeps the historical candidate grid
+(eb 2^13..2^17 x tb 128..2048 — a superset of the tuner's default grid),
+the one-JSON-line-per-point output contract, and the Pallas tile sweep
+(Pallas tiles are launch parameters of a separate kernel, not autotuner
+state, so that section stays inline).
 
 Usage: python scripts/sweep_blocks.py [--events 800000] [--trials 100000]
+       [--kernel grid|general] [--no-poly] [--no-persist]
        [--pallas]  (also sweep the Pallas kernel's trial_tile/event_chunk)
 Run on the accelerator; CPU ratios do not transfer.
 """
@@ -22,6 +26,13 @@ import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
 
+# the historical sweep grid: wider than autotune.DEFAULT_CANDIDATES
+SWEEP_CANDIDATES = tuple(
+    (1 << eb_log2, tb)
+    for eb_log2 in (13, 14, 15, 16, 17)
+    for tb in (128, 256, 512, 1024, 2048)
+)
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -31,6 +42,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--events", type=int, default=800_000)
     ap.add_argument("--trials", type=int, default=100_000)
+    ap.add_argument("--kernel", choices=("grid", "general"), default="grid")
+    ap.add_argument("--no-poly", action="store_true",
+                    help="sweep the hardware-trig path instead of poly trig")
+    ap.add_argument("--no-persist", action="store_true",
+                    help="measure only; do not write the autotune cache")
     ap.add_argument("--pallas", action="store_true")
 
     from crimp_tpu.utils.platform import add_cpu_flag, force_cpu_platform
@@ -43,41 +59,28 @@ def main():
     if args.cpu:
         force_cpu_platform()
 
-    from crimp_tpu.ops import search
-    from crimp_tpu.utils.benchwork import ab_workload, best_rate
+    from crimp_tpu.ops import autotune
 
     log(f"[sweep_blocks] devices: {jax.devices()}")
-    sec, freqs, f0, df = ab_workload(args.events, args.trials)
-
-    results = []
-    for eb_log2 in (13, 14, 15, 16, 17):
-        for tb in (128, 256, 512, 1024, 2048):
-            eb = 1 << eb_log2
-            try:
-                rate = best_rate(
-                    lambda: search.z2_power_grid(
-                        sec, f0, df, args.trials, 2,
-                        event_block=eb, trial_block=tb, poly=True,
-                    ),
-                    args.trials,
-                )
-            except Exception as exc:  # OOM at big tiles must not end the sweep
-                row = {"event_block": eb, "trial_block": tb,
-                       "error": f"{type(exc).__name__}: {str(exc)[:200]}"}
-                print(json.dumps(row), flush=True)
-                continue
-            row = {"event_block": eb, "trial_block": tb,
-                   "trials_per_sec": round(rate, 1)}
-            results.append(row)
-            print(json.dumps(row), flush=True)
-
-    if results:
-        best = max(results, key=lambda r: r["trials_per_sec"])
-        print(json.dumps({"best": best}), flush=True)
+    out = autotune.tune(
+        args.kernel, args.events, args.trials, poly=not args.no_poly,
+        candidates=SWEEP_CANDIDATES, persist=not args.no_persist,
+        on_row=lambda row: print(json.dumps(row), flush=True),
+    )
+    best = {"event_block": out["event_block"], "trial_block": out["trial_block"],
+            "trials_per_sec": out["trials_per_sec"]}
+    print(json.dumps({"best": best}), flush=True)
+    if args.no_persist:
+        log(f"[sweep_blocks] winner NOT persisted (--no-persist): {best}")
+    else:
+        log(f"[sweep_blocks] winner persisted under key {out['key']} "
+            f"in {autotune.cache_path()}")
 
     if args.pallas:
         from crimp_tpu.ops.pallas_z2 import z2_power_grid_pallas
+        from crimp_tpu.utils.benchwork import ab_workload, best_rate
 
+        sec, freqs, f0, df = ab_workload(args.events, args.trials)
         pl_results = []
         for tt in (128, 256, 512):
             for ec in (1024, 2048, 4096):

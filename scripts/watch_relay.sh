@@ -32,6 +32,7 @@
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-onchip_results}"
+mkdir -p "$OUT"
 PERIOD="${2:-60}"
 MAX_HOURS="${3:-8}"
 MAX_ATTEMPTS="${4:-3}"
@@ -44,6 +45,15 @@ DEADLINE=$(( $(date +%s) + $(python -c "print(int(float('$MAX_HOURS') * 3600))")
 export CRIMP_TPU_SESSION_DEADLINE="$DEADLINE"
 ATTEMPTS=0
 TICK=0
+# After a fallback probe is timeout-KILLED (rc 124: it found something to
+# hang on, i.e. a wedged relay — and the kill itself may have left a stale
+# grant), suppress further fallback probes until the grant can have
+# expired. The suspension is wall-clock (grant-expiry scale, ~1 h),
+# independent of PERIOD: with PERIOD=60 the old every-10th-tick rule
+# re-probed a wedged relay every 10 min, each kill refreshing the grant it
+# was waiting out.
+PROBE_BACKOFF_S="${CRIMP_TPU_PROBE_BACKOFF_S:-3600}"
+PROBE_SUSPEND_UNTIL=0
 
 port_open() {
     python - <<EOF
@@ -60,14 +70,20 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     HEALTHY=0
     if port_open; then
         HEALTHY=1
-    elif [ $(( TICK % 10 )) -eq 0 ]; then
+    elif [ $(( TICK % 10 )) -eq 0 ] && [ "$(date +%s)" -ge "$PROBE_SUSPEND_UNTIL" ]; then
         # port closed -> connection refused is immediate; the 290 s budget
         # only guards the import, not a live grant. A cpu platform is a
         # FAILED acquisition (the plugin fell back), never a healthy relay
         # — launching a session on it would burn an attempt on CPU.
-        PLAT="$(timeout 290 python -c 'import jax; print(jax.devices()[0].platform)' 2>/dev/null | tail -1)"
-        if [ -n "$PLAT" ] && [ "$PLAT" != "cpu" ]; then
+        timeout 290 python -c 'import jax; print(jax.devices()[0].platform)' \
+            > "$OUT/.watch_probe_out" 2>/dev/null
+        PROBE_RC=$?
+        PLAT="$(tail -1 "$OUT/.watch_probe_out" 2>/dev/null)"
+        if [ "$PROBE_RC" -eq 0 ] && [ -n "$PLAT" ] && [ "$PLAT" != "cpu" ]; then
             HEALTHY=1
+        elif [ "$PROBE_RC" -eq 124 ]; then
+            PROBE_SUSPEND_UNTIL=$(( $(date +%s) + PROBE_BACKOFF_S ))
+            echo "[watch] fallback probe hung and was killed — suppressing probes for ${PROBE_BACKOFF_S}s (grant expiry); port checks continue"
         fi
     fi
     TICK=$(( TICK + 1 ))

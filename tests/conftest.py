@@ -8,6 +8,18 @@ multi-node testing).
 
 import os
 import pathlib
+import tempfile
+
+# Isolate the persistent caches from the user's real ones: the autotune
+# cache would otherwise make block tiling (and so bit-exact kernel output)
+# depend on whatever a previous sweep persisted on this machine, and the
+# jax compile cache would write into ~/.cache from a test run. Env-level,
+# before any test imports crimp_tpu (which configures both at import).
+os.environ.setdefault(
+    "CRIMP_TPU_AUTOTUNE_CACHE",
+    os.path.join(tempfile.mkdtemp(prefix="crimp_autotune_"), "autotune.json"))
+os.environ.setdefault(
+    "CRIMP_TPU_COMPILE_CACHE", tempfile.mkdtemp(prefix="crimp_jax_cache_"))
 
 # Force 8 virtual CPU devices. NOTE: a site hook may pre-import jax and
 # register an accelerator platform before this file runs, so setting env

@@ -151,3 +151,57 @@ class TestSidecarReconstruction:
             + json.dumps({"stage": "toas", "toas_per_sec": 14.0}) + "\n"
         )
         assert extract_rates.main([str(out), str(tmp_path / "r.json")]) == 1
+
+
+class TestCarriedAndFallthrough:
+    def test_carried_record_is_skipped(self, tmp_path):
+        """bench.py now prints a carried copy of the PREVIOUS round first;
+        extract_rates must never promote that re-print to the guard."""
+        out = tmp_path / "sess"
+        out.mkdir(parents=True)
+        carry = {**BENCH_LINE, "carried": True, "carried_from": "BENCH_r04.json"}
+        (out / "bench.log").write_text(
+            json.dumps(carry) + "\n" + json.dumps(BENCH_LINE) + "\n")
+        dest = tmp_path / "rates.json"
+        assert extract_rates.main([str(out), str(dest)]) == 0
+        # the real (later) record was used; had ONLY the carry existed, the
+        # run must refuse entirely
+        assert json.loads(dest.read_text())["toas_per_sec_pipeline"] == 25.0
+        (out / "bench.log").write_text(json.dumps(carry) + "\n")
+        assert extract_rates.main([str(out), str(tmp_path / "r2.json")]) == 1
+
+    def test_cpu_final_adopts_tpu_sidecar(self, tmp_path):
+        """A retry that completed on CPU must not bury on-chip rows the
+        sidecar holds from the wedged on-chip attempt."""
+        out = tmp_path / "sess"
+        write_bench_log(out, {**BENCH_LINE, "platform": "cpu"})
+        (out / "bench_partial.jsonl").write_text(
+            json.dumps({"stage": "platform", "platform": "tpu"}) + "\n"
+            + json.dumps({"stage": "toas", "toas_per_sec": 21.5}) + "\n"
+            + json.dumps({"stage": "z2", "trials_per_sec_poly": 70000.0}) + "\n"
+        )
+        dest = tmp_path / "rates.json"
+        assert extract_rates.main([str(out), str(dest)]) == 0
+        rates = json.loads(dest.read_text())
+        assert rates["platform"] == "tpu"
+        assert rates["toas_per_sec_pipeline"] == 21.5
+
+    def test_cpu_final_with_cpu_sidecar_still_refused(self, tmp_path):
+        out = tmp_path / "sess"
+        write_bench_log(out, {**BENCH_LINE, "platform": "cpu"})
+        (out / "bench_partial.jsonl").write_text(
+            json.dumps({"stage": "platform", "platform": "cpu"}) + "\n"
+            + json.dumps({"stage": "toas", "toas_per_sec": 5.0}) + "\n"
+        )
+        assert extract_rates.main([str(out), str(tmp_path / "r.json")]) == 1
+
+    def test_sidecar_carry_row_is_ignored(self, tmp_path):
+        """The sidecar's carry row must not be mistaken for a stage row of
+        the reconstruction (it is last round's record, re-printed)."""
+        out = tmp_path / "sess"
+        out.mkdir(parents=True)
+        (out / "bench_partial.jsonl").write_text(
+            json.dumps({"stage": "carry", "platform": "tpu", "value": 99.0,
+                        "carried": True}) + "\n"
+        )
+        assert extract_rates.main([str(out), str(tmp_path / "r.json")]) == 1

@@ -178,17 +178,54 @@ class TestResumableScan:
             ResumableScan(events, freqs, nharm=2, store=str(store),
                           chunk_trials=200)
 
-    def test_store_refuses_block_tiling_change(self, events, tmp_path, monkeypatch):
-        """Block tiling is a module constant this instance cannot adopt —
-        a store written under different grid blocks still refuses."""
+    def test_store_adopts_pinned_block_tiling(self, events, tmp_path,
+                                              monkeypatch):
+        """Block tiling resolves through the autotuner per instance, so a
+        re-tuned winner between sessions is a PREFERENCE drift like a poly
+        toggle: resume adopts the store's pinned tiling (completed chunks
+        stay usable; the result is equal because the statistic is
+        block-invariant). An EXPLICIT CRIMP_TPU_GRID_BLOCKS that conflicts
+        with the pinned tiling still refuses."""
         freqs = np.linspace(0.1428, 0.1436, 400)
         store = tmp_path / "ckpt"
-        ResumableScan(events, freqs, nharm=2, store=str(store),
-                      chunk_trials=200).run()
+        monkeypatch.delenv("CRIMP_TPU_GRID_BLOCKS", raising=False)
+        first = ResumableScan(events, freqs, nharm=2, store=str(store),
+                              chunk_trials=200)
+        power = first.run()
+        # a different tuner winner lands between sessions
         monkeypatch.setattr(search, "GRID_EVENT_BLOCK", 1024)
+        dropped = sorted(store.glob("chunk_*.npy"))[0]
+        dropped.unlink()
+        resumed = ResumableScan(events, freqs, nharm=2, store=str(store),
+                                chunk_trials=200)
+        assert resumed._blocks == first._blocks  # adopted, not re-resolved
+        np.testing.assert_array_equal(resumed.run(), power)
+        # a HAND-PINNED tiling that conflicts is a real mismatch
+        monkeypatch.setenv("CRIMP_TPU_GRID_BLOCKS", "1024,256")
         with pytest.raises(ValueError, match="fingerprint mismatch"):
             ResumableScan(events, freqs, nharm=2, store=str(store),
                           chunk_trials=200)
+        # ... unless it agrees with the store's pinned tiling
+        monkeypatch.setenv("CRIMP_TPU_GRID_BLOCKS",
+                           f"{first._blocks[0]},{first._blocks[1]}")
+        agreeing = ResumableScan(events, freqs, nharm=2, store=str(store),
+                                 chunk_trials=200)
+        assert agreeing._blocks == first._blocks
+
+    def test_streamed_chunks_bitmatch_unstreamed(self, events, tmp_path,
+                                                 monkeypatch):
+        """Above CRIMP_TPU_STREAM_MIN_EVENTS the fast-path chunks stream
+        the event axis with double-buffered transfers; the assembled power
+        must be BIT-identical to the non-streamed chunked scan."""
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        monkeypatch.delenv("CRIMP_TPU_STREAM_MIN_EVENTS", raising=False)
+        plain = ResumableScan(events, freqs, nharm=2, chunk_trials=200)
+        assert not plain._stream()
+        want = plain.run()
+        monkeypatch.setenv("CRIMP_TPU_STREAM_MIN_EVENTS", "1")
+        streamed = ResumableScan(events, freqs, nharm=2, chunk_trials=200)
+        assert streamed._stream()
+        np.testing.assert_array_equal(streamed.run(), want)
 
     def test_store_refuses_older_kernel_version(self, events, tmp_path):
         """Chunks from an older kernel-semantics version must be refused on

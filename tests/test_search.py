@@ -502,3 +502,69 @@ class Test2DGridFastPath:
         # reference row ordering: outer fdot, inner freq
         assert list(df.columns) == ["Freq", "Freq_dot", "Z2pow"]
         assert np.allclose(df["Freq_dot"].to_numpy()[:64], -12.0)
+
+
+class TestStreamedGrid:
+    """Double-buffered streamed kernels must be BIT-identical to the
+    monolithic blockwise kernels at the same tiling: the chunk boundaries
+    are event_block multiples and the per-chunk carry update replays the
+    monolithic scan body, so the f64 addition order is unchanged."""
+
+    @pytest.fixture()
+    def odd_times(self):
+        # deliberately NOT a multiple of event_block or event_chunk, so the
+        # padded tail chunk and the mid-stream chunks are both exercised
+        rng = np.random.RandomState(11)
+        return np.sort(rng.uniform(0.0, 350.0, 5000 + 123))
+
+    def test_z2_streamed_bitmatches_monolithic(self, odd_times):
+        for poly in (False, True):
+            mono = np.asarray(search.z2_power_grid(
+                odd_times, 0.2, 1e-5, 300, nharm=2,
+                event_block=512, trial_block=64, poly=poly))
+            strm = np.asarray(search.z2_power_grid_streamed(
+                odd_times, 0.2, 1e-5, 300, nharm=2,
+                event_block=512, trial_block=64, poly=poly, event_chunk=1024))
+            np.testing.assert_array_equal(strm, mono)
+
+    def test_h_streamed_bitmatches_monolithic(self, odd_times):
+        mono = np.asarray(search.h_power_grid(
+            odd_times, 0.2, 1e-5, 300, nharm=5,
+            event_block=512, trial_block=64, poly=True))
+        strm = np.asarray(search.h_power_grid_streamed(
+            odd_times, 0.2, 1e-5, 300, nharm=5,
+            event_block=512, trial_block=64, poly=True, event_chunk=2048))
+        np.testing.assert_array_equal(strm, mono)
+
+    def test_2d_streamed_bitmatches_monolithic(self, odd_times):
+        fdots = np.linspace(-1e-9, 1e-9, 3)
+        mono = np.asarray(search.z2_power_2d_grid(
+            odd_times, 0.2, 1e-5, 200, fdots, nharm=2,
+            event_block=512, trial_block=64, poly=True))
+        strm = np.asarray(search.z2_power_2d_grid_streamed(
+            odd_times, 0.2, 1e-5, 200, fdots, nharm=2,
+            event_block=512, trial_block=64, poly=True, event_chunk=1024))
+        np.testing.assert_array_equal(strm, mono)
+
+    def test_single_chunk_degenerates_to_monolithic(self, odd_times):
+        # event_chunk >= n: one chunk, still bit-identical
+        mono = np.asarray(search.z2_power_grid(
+            odd_times, 0.2, 1e-5, 100, nharm=2,
+            event_block=512, trial_block=64))
+        strm = np.asarray(search.z2_power_grid_streamed(
+            odd_times, 0.2, 1e-5, 100, nharm=2,
+            event_block=512, trial_block=64, event_chunk=1 << 22))
+        np.testing.assert_array_equal(strm, mono)
+
+    def test_stream_min_events_env(self, monkeypatch):
+        monkeypatch.delenv("CRIMP_TPU_STREAM_MIN_EVENTS", raising=False)
+        assert search.stream_min_events() == 1 << 22
+        monkeypatch.setenv("CRIMP_TPU_STREAM_MIN_EVENTS", "0")
+        assert search.stream_min_events() is None
+        monkeypatch.setenv("CRIMP_TPU_STREAM_MIN_EVENTS", "off")
+        assert search.stream_min_events() is None
+        monkeypatch.setenv("CRIMP_TPU_STREAM_MIN_EVENTS", "12345")
+        assert search.stream_min_events() == 12345
+        monkeypatch.setenv("CRIMP_TPU_STREAM_MIN_EVENTS", "lots")
+        with pytest.raises(ValueError, match="CRIMP_TPU_STREAM_MIN_EVENTS"):
+            search.stream_min_events()

@@ -444,3 +444,68 @@ class TestLogging:
             for handler in saved_handlers:
                 root.addHandler(handler)
             root.setLevel(saved_level)
+
+
+class TestAOTWarmup:
+    def test_warmup_seeds_the_persistent_cache_for_real_calls(self):
+        """crimp_tpu.warmup AOT-compiles the hot kernels at the given
+        shapes. AOT executables don't enter jit's dispatch cache, so the
+        payoff flows through the persistent compilation cache: the first
+        REAL call at the warmed shapes must be a cache *hit*, not a fresh
+        backend compile of the kernel."""
+        import jax.numpy as jnp
+
+        import crimp_tpu
+        from crimp_tpu.ops import autotune, search
+        from crimp_tpu.utils import profiling
+
+        report = crimp_tpu.warmup(n_events=3000, n_trials=256, nharm=2,
+                                  poly=False)
+        assert report["total_s"] >= 0
+        errors = {n: t for n, t in report["targets"].items() if "error" in t}
+        assert not errors, errors
+
+        # Materialize the input first: jnp.linspace jit-compiles its own
+        # tiny program, which would count as a miss inside the window.
+        times = jnp.linspace(0.0, 80.0, 3000).block_until_ready()
+        before = profiling.compile_counters()
+        out = search.harmonic_sums_uniform(
+            times, 0.143, 6e-9, 256, 2,
+            *autotune.resolve_blocks("grid", 3000, 256), poly=False)
+        out[0].block_until_ready()
+        after = profiling.compile_counters()
+        hits = after["cache_hits"] - before["cache_hits"]
+        misses = after["cache_misses"] - before["cache_misses"]
+        # Same shapes + same resolved blocks => same HLO => cache hit. A
+        # miss here means warmup's traced avals drifted from the runtime
+        # call's (the shape-discipline contract in crimp_tpu/aot.py).
+        assert hits >= 1 and misses == 0, (hits, misses)
+
+    def test_warmup_reports_compile_counters(self):
+        import crimp_tpu
+
+        report = crimp_tpu.warmup(n_events=2000, n_trials=128, nharm=2,
+                                  poly=True, mcmc={"walkers": 8, "ndim": 2,
+                                                   "steps": 10})
+        counters = report["counters"]
+        for key in ("cache_hits", "cache_misses", "backend_compile_s"):
+            assert key in counters
+        assert any("mcmc" in n.lower() or "ensemble" in n.lower()
+                   for n in report["targets"])
+
+    def test_compile_listeners_idempotent_and_counting(self):
+        """profiling's jax-monitoring listeners install once and count
+        compile-cache events; reset zeroes the counters."""
+        import jax
+        import jax.numpy as jnp
+
+        from crimp_tpu.utils import profiling
+
+        assert profiling.install_compile_listeners()
+        assert profiling.install_compile_listeners()  # idempotent
+        profiling.reset_compile_counters()
+        base = profiling.compile_counters()
+        assert base["cache_hits"] == 0 and base["cache_misses"] == 0
+        jax.jit(lambda x: x * 2 + 1)(jnp.arange(7.0)).block_until_ready()
+        after = profiling.compile_counters()
+        assert after["cache_hits"] + after["cache_misses"] >= 1
