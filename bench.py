@@ -269,9 +269,11 @@ def slice_intervals(times: np.ndarray, starts, ends) -> list[np.ndarray]:
 
 
 def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np.ndarray, intervals) -> dict:
-    """Batched ToA extraction over the committed 84 intervals."""
-    import jax.numpy as jnp
-
+    """Batched ToA extraction over the committed 84 intervals, with the
+    ToA-engine A/B (dense vs loop error scan, bf16 vs f32 profile sweep)
+    measured the same way the Z^2 bench A/Bs its trig paths: every variant's
+    rate lands in the record, the headline only uses a variant its measured
+    deviation qualifies."""
     from crimp_tpu.io import template as template_io
     from crimp_tpu.models import profiles, timing
     from crimp_tpu.ops import anchored, search, toafit
@@ -281,24 +283,77 @@ def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np
     tpl_dict = template_io.read_template(template_path)
     kind, tpl = profiles.from_template(tpl_dict)
 
-    def run_once():
-        starts = intervals["ToA_tstart"].to_numpy()
-        ends = intervals["ToA_tend"].to_numpy()
-        exposures = intervals["ToA_exposure"].to_numpy().astype(float)
-        seg_times = slice_intervals(times, starts, ends)
-        toa_mids = np.array([(t[-1] - t[0]) / 2 + t[0] for t in seg_times])
-        am = anchored.prepare_anchors(tm, toa_mids)
-        seg_sizes = [t.size for t in seg_times]
-        anchor_idx = np.repeat(np.arange(len(seg_times)), seg_sizes)
-        all_times = np.concatenate(seg_times)
-        delta_all = anchored.anchor_deltas(all_times, toa_mids, anchor_idx)
-        folded_all = np.asarray(
-            anchored.anchored_fold(am, jnp.asarray(delta_all), jnp.asarray(anchor_idx))
-        )
-        seg_phases = list(np.split(folded_all, np.cumsum(seg_sizes)[:-1]))
-        phases, masks = toafit.pad_segments(seg_phases)
-        cfg = toafit.ToAFitConfig(kind=kind, ph_shift_res=1000, nbins=15)
+    starts = intervals["ToA_tstart"].to_numpy()
+    ends = intervals["ToA_tend"].to_numpy()
+    exposures = intervals["ToA_exposure"].to_numpy().astype(float)
+    n_toas = len(intervals)
+    base_cfg = toafit.ToAFitConfig(kind=kind, ph_shift_res=1000, nbins=15)
+
+    # prebuilt batch for the engine A/B (fit only — fold/H-test identical
+    # across variants, so they would only dilute the comparison)
+    seg_times = slice_intervals(times, starts, ends)
+    seg_phases, toa_mids = anchored.fold_segments(tm, seg_times)
+    phases, masks = toafit.pad_segments(seg_phases)
+
+    def fit_with(cfg):
         fit = toafit.fit_toas_batch(kind, tpl, phases, masks, exposures, cfg)
+        return {k: np.asarray(v) for k, v in fit.items()}
+
+    ab: dict = {}
+    fits: dict = {}
+
+    def ab_variant(key: str, cfg) -> None:
+        try:
+            fit_with(cfg)  # compile
+            t0 = time.perf_counter()
+            fits[key] = fit_with(cfg)
+            wall = time.perf_counter() - t0
+            ab[f"toas_per_sec_{key}"] = n_toas / wall
+            log(f"[bench] ToA engine [{key}]: {n_toas} fits in {wall:.2f}s "
+                f"= {ab[f'toas_per_sec_{key}']:.1f} ToA/s")
+        except Exception as exc:  # noqa: BLE001 - record and continue
+            ab[f"toas_per_sec_{key}"] = None
+            log(f"[bench] ToA engine [{key}] skipped: "
+                f"{type(exc).__name__}: {str(exc)[:200]}")
+
+    ab_variant("dense", base_cfg)
+    ab_variant("loop", base_cfg._replace(err_dense_window=0))
+    ab_variant("bf16", base_cfg._replace(mxu_bf16=1))
+
+    if "dense" in fits and "loop" in fits:
+        ab["dense_loop_identical"] = bool(
+            np.array_equal(fits["dense"]["phShift_LL"], fits["loop"]["phShift_LL"])
+            and np.array_equal(fits["dense"]["phShift_UL"], fits["loop"]["phShift_UL"])
+        )
+        ab["dense_loop_iters_mean"] = float(
+            np.mean(fits["dense"]["errScanLoopIters"])
+        )
+    median_err = (
+        float(np.median(fits["dense"]["phShift_UL"])) if "dense" in fits else None
+    )
+    if "dense" in fits and "bf16" in fits:
+        ab["bf16_max_dev_rad"] = float(
+            np.max(np.abs(fits["bf16"]["phShift"] - fits["dense"]["phShift"]))
+        )
+    # the headline run uses bf16 only when it is measurably faster AND its
+    # phShift deviation on this very workload stays well under the error
+    # bars (never trade correctness for the headline number)
+    bf16_used = bool(
+        ab.get("toas_per_sec_bf16")
+        and ab.get("toas_per_sec_dense")
+        and ab["toas_per_sec_bf16"] > 1.2 * ab["toas_per_sec_dense"]
+        and ab.get("bf16_max_dev_rad") is not None
+        and median_err is not None
+        and ab["bf16_max_dev_rad"] < 0.1 * median_err
+    )
+    ab["bf16_used"] = bf16_used
+    headline_cfg = base_cfg._replace(mxu_bf16=1) if bf16_used else base_cfg
+
+    def run_once():
+        seg_times = slice_intervals(times, starts, ends)
+        seg_phases, toa_mids = anchored.fold_segments(tm, seg_times)
+        phases, masks = toafit.pad_segments(seg_phases)
+        fit = toafit.fit_toas_batch(kind, tpl, phases, masks, exposures, headline_cfg)
         fit = {k: np.asarray(v) for k, v in fit.items()}
         # per-ToA H-test at the local ephemeris frequency
         freqs_mid, _ = spin_frequency_host(tm, toa_mids)
@@ -315,18 +370,8 @@ def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np
     # North-star check (outside the timed region): device fold vs the host
     # longdouble reference, <1 us target. Frac extraction stays in
     # longdouble so the comparison measures device error, not cast noise.
-    starts = intervals["ToA_tstart"].to_numpy()
-    ends = intervals["ToA_tend"].to_numpy()
-    seg_times = slice_intervals(times, starts, ends)
-    toa_mids = np.array([(t[-1] - t[0]) / 2 + t[0] for t in seg_times])
-    am = anchored.prepare_anchors(tm, toa_mids)
-    sizes = [t.size for t in seg_times]
-    anchor_idx = np.repeat(np.arange(len(seg_times)), sizes)
     all_times = np.concatenate(seg_times)
-    deltas = anchored.anchor_deltas(all_times, toa_mids, anchor_idx)
-    folded = np.asarray(
-        anchored.anchored_fold(am, jnp.asarray(deltas), jnp.asarray(anchor_idx))
-    )
+    folded = np.concatenate(seg_phases)
     sample = slice(0, len(all_times), max(1, len(all_times) // 20000))
     host_phase = anchored.host_total_phase(tm, all_times[sample])  # longdouble
     host_frac = np.asarray(host_phase - np.floor(host_phase), dtype=np.float64)
@@ -339,7 +384,6 @@ def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np
     t0 = time.perf_counter()
     fit = run_once()
     wall = time.perf_counter() - t0
-    n_toas = len(intervals)
     return {
         "wall_s": wall,
         "toas_per_sec": n_toas / wall,
@@ -347,6 +391,7 @@ def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np
         "median_abs_phshift": float(np.median(np.abs(fit["phShift"]))),
         "median_err": float(np.median(fit["phShift_UL"])),
         "median_H": float(np.median(fit["Hpower"])),
+        "engine_ab": ab,
     }
 
 
@@ -445,8 +490,6 @@ def bench_north_star(par_path: str, template_path: str, times: np.ndarray, inter
     """The BASELINE north star as ONE wall clock: full 2-D (nu, nudot) Z^2
     scan (1e5 trials: 2500 nu x 40 nudot) + the 84-ToA extraction on the
     bundled-campaign surrogate. Target <10 s."""
-    import jax.numpy as jnp
-
     from crimp_tpu.io import template as template_io
     from crimp_tpu.models import profiles, timing
     from crimp_tpu.ops import anchored, search, toafit
@@ -470,15 +513,7 @@ def bench_north_star(par_path: str, template_path: str, times: np.ndarray, inter
         rows, _ = ps.twod_ztest(log_fdots)
         # --- ToA extraction over the committed 84 intervals ----------------
         seg_times = slice_intervals(times, starts, ends)
-        toa_mids = np.array([(t[-1] - t[0]) / 2 + t[0] for t in seg_times])
-        am = anchored.prepare_anchors(tm, toa_mids)
-        sizes = [t.size for t in seg_times]
-        anchor_idx = np.repeat(np.arange(len(seg_times)), sizes)
-        deltas = anchored.anchor_deltas(np.concatenate(seg_times), toa_mids, anchor_idx)
-        folded = np.asarray(
-            anchored.anchored_fold(am, jnp.asarray(deltas), jnp.asarray(anchor_idx))
-        )
-        seg_phases = list(np.split(folded, np.cumsum(sizes)[:-1]))
+        seg_phases, toa_mids = anchored.fold_segments(tm, seg_times)
         phases, masks = toafit.pad_segments(seg_phases)
         cfg = toafit.ToAFitConfig(kind=kind, ph_shift_res=1000, nbins=15)
         fit = toafit.fit_toas_batch(kind, tpl, phases, masks, exposures, cfg)
@@ -663,7 +698,7 @@ def main():
             "platform": platform, "errors": errors,
         }
         emit_partial("final", record)
-        print(json.dumps(record))
+        print(json.dumps(record), flush=True)
         return
     times, intervals = built
     log(f"[bench] surrogate: {len(times)} events over {len(intervals)} intervals")
@@ -745,6 +780,9 @@ def main():
         "config4_toas_per_sec": round(cfg4["toas_per_sec"], 1) if cfg4 else None,
         "config4_recovered_frac": cfg4["recovered_frac"] if cfg4 else None,
         "warmup_s": warm["warmup_s"] if warm else None,
+        # ToA-engine A/B: dense vs loop error scan (bit-identical bounds
+        # asserted), bf16 vs f32 profile sweep (deviation-gated headline use)
+        "toa_engine_ab": toas["engine_ab"] if toas else None,
     }
     # whole-process compile/cache telemetry: how much compilation this run
     # paid for vs retrieved from the persistent cache
@@ -766,7 +804,10 @@ def main():
     if errors:
         record["errors"] = errors
     emit_partial("final", record)
-    print(json.dumps(record))
+    # stdout carries ONLY JSON records (all chatter goes through log() to
+    # stderr); flushed so an external kill right after this line cannot
+    # leave the official record stuck in a stdio buffer
+    print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":

@@ -278,6 +278,41 @@ def anchored_fold(am: AnchoredModel, delta: jax.Array, anchor_idx: jax.Array) ->
 
 
 # ---------------------------------------------------------------------------
+# Batched host wrappers
+# ---------------------------------------------------------------------------
+
+
+def fold_segments(timMod, seg_times, t_ref_mjd=None):
+    """Anchored fold of ragged per-segment event times in ONE device call.
+
+    The ToA-pipeline fold dance — one anchor per segment, events
+    concatenated with a per-event anchor index so the kernel compiles once
+    regardless of per-segment raggedness — shared by measure_toas and the
+    bench workloads. ``t_ref_mjd`` defaults to each segment's midpoint
+    (t0 + (t_end - t0)/2, the reference's ToA epoch). Returns
+    (seg_phase_list, t_ref): cycle-folded [0,1) phases split back per
+    segment, plus the anchors used. Empty segments fold to empty arrays.
+    """
+    seg_times = [np.atleast_1d(np.asarray(t, dtype=np.float64)) for t in seg_times]
+    if t_ref_mjd is None:
+        t_ref = np.asarray(
+            [(t[-1] - t[0]) / 2 + t[0] if t.size else 0.0 for t in seg_times]
+        )
+    else:
+        t_ref = np.atleast_1d(np.asarray(t_ref_mjd, dtype=np.float64))
+    if not seg_times:
+        return [], t_ref
+    am = prepare_anchors(timMod, t_ref)
+    sizes = [t.size for t in seg_times]
+    anchor_idx = np.repeat(np.arange(len(seg_times)), sizes)
+    delta = anchor_deltas(np.concatenate(seg_times), t_ref, anchor_idx)
+    folded = np.asarray(
+        anchored_fold(am, jnp.asarray(delta), jnp.asarray(anchor_idx))
+    )
+    return list(np.split(folded, np.cumsum(sizes)[:-1])), t_ref
+
+
+# ---------------------------------------------------------------------------
 # Chunked host wrapper: accurate folding for arbitrary time arrays
 # ---------------------------------------------------------------------------
 

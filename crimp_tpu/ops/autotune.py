@@ -37,6 +37,9 @@ Env knobs:
   ``$XDG_CACHE_HOME/crimp_tpu/autotune.json``).
 - ``CRIMP_TPU_GRID_BLOCKS``: hard override for the grid kernels,
   unchanged semantics (malformed values raise).
+- ``CRIMP_TPU_TOA_DENSE_WINDOW`` / ``CRIMP_TPU_MXU_BF16``: hard overrides
+  for the ToA-engine knobs resolved by ``resolve_toafit()`` (dense
+  error-scan window width; bf16 MXU profile sweeps). Malformed raises.
 """
 
 from __future__ import annotations
@@ -223,6 +226,103 @@ def resolve_blocks(kernel: str, n_events: int, n_trials: int,
     eb = int(event_block) if event_block is not None else int(resolved[0])
     tb = int(trial_block) if trial_block is not None else int(resolved[1])
     return eb, tb
+
+
+# -- ToA-engine knobs (toafit) ----------------------------------------------
+#
+# The ToA fit exposes two throughput knobs that are numerically safe to
+# tune: the dense error-scan first-window width (any value is bit-identical
+# — it only moves work between the one-shot dense sweep and the fallback
+# while_loop) and the bf16 MXU profile-sweep mode (accuracy-checked by
+# scripts/tune_toafit.py and bench.py before it is ever cached as 1).
+# Cache key: <platform>|<device_kind>|toafit|seg<log2 segments>|ev<log2 events>.
+# Unlike the block sizes there is NO eager tuning path — the sweep lives in
+# scripts/tune_toafit.py, which persists winners via store_toafit();
+# resolve_toafit() only ever reads env + cache.
+
+TOAFIT_DENSE_WINDOW_ENV = "CRIMP_TPU_TOA_DENSE_WINDOW"
+MXU_BF16_ENV = "CRIMP_TPU_MXU_BF16"
+
+
+def toafit_defaults() -> dict:
+    from crimp_tpu.ops import toafit
+
+    return {"err_dense_window": toafit.DENSE_WINDOW_DEFAULT, "mxu_bf16": 0}
+
+
+def toafit_cache_key(n_segments: int, n_events: int,
+                     platform: str | None = None,
+                     device_kind: str | None = None) -> str:
+    if platform is None or device_kind is None:
+        platform, device_kind = device_fingerprint()
+    return "|".join([
+        platform, device_kind, "toafit",
+        f"seg{_bucket(n_segments)}", f"ev{_bucket(n_events)}",
+    ])
+
+
+def cached_toafit(n_segments: int, n_events: int) -> dict | None:
+    entry = _load_cache().get(toafit_cache_key(n_segments, n_events))
+    if not isinstance(entry, dict):
+        return None
+    w, b = entry.get("err_dense_window"), entry.get("mxu_bf16")
+    if isinstance(w, int) and w >= 0 and b in (0, 1):
+        return {"err_dense_window": w, "mxu_bf16": b}
+    return None
+
+
+def store_toafit(n_segments: int, n_events: int, entry: dict,
+                 path: pathlib.Path | None = None) -> None:
+    """Persist a tuned ToA-knob winner (scripts/tune_toafit.py calls this)."""
+    _store_entry(toafit_cache_key(n_segments, n_events), entry, path)
+
+
+def _env_nonneg_int(name: str, valid=None) -> int | None:
+    """Parse an integer env knob; unset/blank -> None, malformed raises
+    (matching CRIMP_TPU_GRID_BLOCKS: a typo'd override must not silently
+    fall back to defaults)."""
+    env = os.environ.get(name, "").strip()
+    if not env:
+        return None
+    try:
+        val = int(env)
+    except ValueError:
+        raise ValueError(f"{name}={env!r} is not an integer") from None
+    if val < 0 or (valid is not None and val not in valid):
+        allowed = "/".join(map(str, valid)) if valid else ">= 0"
+        raise ValueError(f"{name}={env!r} out of range (expected {allowed})")
+    return val
+
+
+def resolve_toafit(n_segments: int, n_events: int) -> dict:
+    """Resolve {err_dense_window, mxu_bf16} for a ToA workload.
+
+    Precedence per knob: env var (CRIMP_TPU_TOA_DENSE_WINDOW /
+    CRIMP_TPU_MXU_BF16 — hard overrides, honored even with autotune off)
+    > cached tuner winner (unless CRIMP_TPU_AUTOTUNE=0) > static defaults
+    (DENSE_WINDOW_DEFAULT, bf16 off). Never times anything: the ToA sweep
+    is explicit tooling (scripts/tune_toafit.py), not an implicit
+    library-call side effect, because enabling bf16 requires an accuracy
+    gate a blind timing loop cannot provide.
+    """
+    out = toafit_defaults()
+    env_w = _env_nonneg_int(TOAFIT_DENSE_WINDOW_ENV)
+    env_b = _env_nonneg_int(MXU_BF16_ENV, valid=(0, 1))
+    if (env_w is None or env_b is None) and autotune_mode() != "off":
+        try:
+            cached = cached_toafit(n_segments, n_events)
+        except Exception:  # noqa: BLE001 — a corrupt cache or an
+            # uninitializable backend must never take down a ToA fit
+            logger.warning("toafit autotune cache lookup failed; using "
+                           "static defaults", exc_info=True)
+            cached = None
+        if cached:
+            out.update(cached)
+    if env_w is not None:
+        out["err_dense_window"] = env_w
+    if env_b is not None:
+        out["mxu_bf16"] = env_b
+    return out
 
 
 # -- timing / tuning --------------------------------------------------------

@@ -56,6 +56,13 @@ from crimp_tpu.ops.optimize import bounded_transform, golden_section, nelder_mea
 # (measureToAs.py:324). Hard-coded to keep the kernel host-independent.
 CHI2_1SIG_HALF = 0.4999320306186937
 
+# Default first-window width (steps per side) of the dense two-phase error
+# scan. 2W phis evaluate in ONE profile sweep, so the footprint matches the
+# proven-safe brute_chunk=64 launch; W=32 covers bounds up to 32 scan steps
+# (~0.2 rad at res=1000 — an order above the campaign's ~3e-2 rad bars)
+# before the chunked while_loop fallback has any work left.
+DENSE_WINDOW_DEFAULT = 32
+
 
 class ToAFitConfig(NamedTuple):
     """Static configuration for the batched ToA fit."""
@@ -103,6 +110,19 @@ class ToAFitConfig(NamedTuple):
     fix_norm: bool = False  # pin the norm at the template value (the
     # readvaryparam all-fixed case: reference keeps nbrFreeParams=0 and
     # does NOT free the norm, defineinitialfitparam readvaryparam branch)
+    # Dense two-phase error scan: first-window width in STEPS PER SIDE.
+    # -1 = auto (DENSE_WINDOW_DEFAULT at trace time; the host wrappers may
+    # first substitute an env/autotune-cache value via resolve_runtime_cfg);
+    # 0 = pure chunked while_loop path (the pre-dense reference behavior).
+    # Any value is bit-identical — the knob only moves work between the
+    # one-shot dense sweep and the serial fallback loop.
+    err_dense_window: int = -1
+    # bf16 MXU profile sweeps, tri-state: -1 = auto (off at trace time;
+    # resolve_runtime_cfg may enable it from CRIMP_TPU_MXU_BF16 or the
+    # autotune cache), 0 = exact f32/f64 matmul, 1 = bf16 operands with f32
+    # accumulation. Only the Fourier shape_at_shifts sweep is affected; the
+    # binned-chi2 report stays exact.
+    mxu_bf16: int = -1
 
 
 def _phase_range(kind: str) -> float:
@@ -124,13 +144,32 @@ def _fourier_event_coeffs(tpl: ProfileParams, x: jax.Array):
     return amp[None, :] * jnp.cos(theta), amp[None, :] * jnp.sin(theta)
 
 
-def shape_at_shifts(kind: str, tpl: ProfileParams, x: jax.Array, phis: jax.Array) -> jax.Array:
-    """s(x_i; phi) for all (phi, event) pairs -> (n_phi, n_event)."""
+def shape_at_shifts(
+    kind: str, tpl: ProfileParams, x: jax.Array, phis: jax.Array, bf16: bool = False
+) -> jax.Array:
+    """s(x_i; phi) for all (phi, event) pairs -> (n_phi, n_event).
+
+    ``bf16`` (Fourier only) runs the (P, K) x (K, N) matmuls with bf16
+    operands and f32 accumulation (preferred_element_type) — the MXU's
+    native mode. The trig factors and per-event coefficients are computed
+    exactly first, so the only rounding is the K-term contraction.
+    """
     if kind == FOURIER:
         C, S = _fourier_event_coeffs(tpl, x)  # (N, K)
         j = jnp.arange(1, tpl.n_comp + 1, dtype=x.dtype)
         cosj = jnp.cos(j[None, :] * phis[:, None])  # (P, K)
         sinj = jnp.sin(j[None, :] * phis[:, None])
+        if bf16:
+            acc = jnp.matmul(
+                cosj.astype(jnp.bfloat16),
+                C.T.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            ) + jnp.matmul(
+                sinj.astype(jnp.bfloat16),
+                S.T.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+            return acc.astype(x.dtype)
         return cosj @ C.T + sinj @ S.T  # MXU matmul: (P, N)
 
     def add_comp(carry, comp):
@@ -261,7 +300,7 @@ def profile_loglik_full(kind, tpl, x, mask, exposure, phis, cfg: ToAFitConfig, w
     if cfg.free_idx:
         return _general_profile_loglik(kind, tpl, x, mask, exposure, phis, cfg, warm_vec)
     n_events = jnp.sum(mask)
-    s = shape_at_shifts(kind, tpl, x, phis)
+    s = shape_at_shifts(kind, tpl, x, phis, bf16=cfg.mxu_bf16 == 1)
     if cfg.vary_amps:
         a, b = _optimal_norm_amp(kind, tpl, s, mask, exposure, n_events, cfg)
     elif cfg.fix_norm:
@@ -454,7 +493,7 @@ def _binned_chi2(kind, tpl, x, mask, exposure, phi_best, a_best, b_best, cfg: To
 
 
 def _error_scan(kind, tpl, x, mask, exposure, phi_best, ll_max, cfg: ToAFitConfig, warm_vec=None):
-    """Likelihood-profile 1-sigma bounds by chunked vectorized stepping.
+    """Likelihood-profile 1-sigma bounds: dense first window + chunked loop.
 
     Reproduces the reference counting: the reported bound is
     (k*+1)*step + step/2 where k* is the first step whose LL drop exceeds
@@ -462,16 +501,55 @@ def _error_scan(kind, tpl, x, mask, exposure, phi_best, ll_max, cfg: ToAFitConfi
     saturates (measureToAs.py:331-376). In readvaryparam mode ``warm_vec``
     (the best-fit vector) seeds every per-step Nelder-Mead so the scan
     refines from the optimum instead of restarting cold at the template.
+
+    Two phases. Phase 1 evaluates BOTH sides' first W steps in ONE profile
+    sweep — a (2W x events) launch, MXU-shaped for Fourier — and extracts
+    each side's first crossing with argmax. Phase 2 is the original chunked
+    while_loop, seeded at k0 = W: under vmap it runs zero iterations when
+    every segment in the batch crossed inside its window (the common case —
+    W=32 covers bounds an order of magnitude above typical error bars), so
+    the per-side serial dependency chain disappears. Per-phi profile values
+    are row-independent (the inner Newton solve never mixes grid points), so
+    any W yields bit-identical bounds; the knob only moves work between the
+    dense sweep and the fallback loop.
+
+    Returns (err_lo, err_hi, loop_iters) with loop_iters the number of
+    fallback while_loop bodies this segment executed (both sides summed) —
+    0 means the dense window fully covered the scan.
     """
     step = (2 * jnp.pi) / cfg.ph_shift_res
     max_k = cfg.ph_shift_res // 2
     chunk = cfg.err_chunk
+    W = cfg.err_dense_window if cfg.err_dense_window >= 0 else DENSE_WINDOW_DEFAULT
+    W = min(W, max_k)
 
     def scan_profile(phis):
         ll, _ = profile_loglik(kind, tpl, x, mask, exposure, phis, cfg, warm_vec)
         return ll
 
-    def one_side(sign):
+    if W > 0:
+        ks_w = 1 + jnp.arange(W)
+        phis_dense = jnp.concatenate(
+            [phi_best - ks_w * step, phi_best + ks_w * step]
+        )
+        dense_cross = (ll_max - scan_profile(phis_dense)) > CHI2_1SIG_HALF
+
+        def seed(block):
+            # first crossing within the window; no crossing -> saturated
+            # kstop placeholder that the fallback loop overwrites (or keeps,
+            # when W == max_k and the scan really saturates)
+            any_cross = jnp.any(block)
+            k_star = ks_w[jnp.argmax(block)]
+            kstop = jnp.where(any_cross, k_star + 1, max_k + 1)
+            return (jnp.asarray(W), any_cross, kstop)
+
+        init_lo = seed(dense_cross[:W])
+        init_hi = seed(dense_cross[W:])
+    else:
+        cold = (jnp.asarray(0), jnp.asarray(False), jnp.asarray(max_k + 1))
+        init_lo = init_hi = cold
+
+    def one_side(sign, init):
         def cond(state):
             k0, found, _ = state
             return (~found) & (k0 < max_k)
@@ -490,11 +568,13 @@ def _error_scan(kind, tpl, x, mask, exposure, phi_best, ll_max, cfg: ToAFitConfi
             new_kstop = jnp.where(~found & any_cross, k_star + 1, kstop)
             return (k0 + chunk, new_found, new_kstop)
 
-        init = (jnp.asarray(0), jnp.asarray(False), jnp.asarray(max_k + 1))
-        _, found, kstop = jax.lax.while_loop(cond, body, init)
-        return kstop * step + step / 2
+        k0_fin, _, kstop = jax.lax.while_loop(cond, body, init)
+        iters = (k0_fin - init[0]) // chunk
+        return kstop * step + step / 2, iters
 
-    return one_side(-1.0), one_side(+1.0)
+    err_lo, it_lo = one_side(-1.0, init_lo)
+    err_hi, it_hi = one_side(+1.0, init_hi)
+    return err_lo, err_hi, it_lo + it_hi
 
 
 def fit_segment(kind: str, tpl: ProfileParams, x: jax.Array, mask: jax.Array, exposure: jax.Array, cfg: ToAFitConfig) -> dict:
@@ -589,7 +669,7 @@ def fit_segment(kind: str, tpl: ProfileParams, x: jax.Array, mask: jax.Array, ex
     # 4) likelihood-profile error bounds (in readvaryparam mode each step's
     #    Nelder-Mead starts from the best-fit vector, not the cold template)
     warm = vec_best if cfg.free_idx else None
-    err_lo, err_hi = _error_scan(
+    err_lo, err_hi, scan_iters = _error_scan(
         kind, tpl, x, mask, exposure, phi_best, ll_max, cfg, warm
     )
 
@@ -612,6 +692,10 @@ def fit_segment(kind: str, tpl: ProfileParams, x: jax.Array, mask: jax.Array, ex
         "ampShift": b_best,
         "logLmax": ll_max,
         "redChi2": red_chi2,
+        # fallback while_loop bodies the error scan ran (both sides): 0 when
+        # the dense first window covered the whole scan — the diagnostic the
+        # dense-path tests and bench A/B key off
+        "errScanLoopIters": scan_iters,
         # full flattened best-fit parameter vector [norm, amps, locs, wids,
         # ampShift] — in general (readvaryparam) mode this carries the REFIT
         # shape, which callers must use to reproduce the fitted model
@@ -634,6 +718,30 @@ def fit_toas_batch(
     )
 
 
+def resolve_runtime_cfg(cfg: ToAFitConfig, n_segments: int, n_events: int) -> ToAFitConfig:
+    """Fill the cfg's auto (-1) knobs from env / autotune cache.
+
+    HOST-side, before the jit trace: ``cfg`` is a static argument of
+    ``fit_toas_batch``, so env and cache consults must never happen inside
+    the traced function. Explicit (>= 0) values always win; -1 sentinels
+    resolve through ``autotune.resolve_toafit`` (env var > cached winner >
+    static default). Called by the host wrappers (``fit_toas_batch_auto``,
+    ``fit_toas_bucketed``); direct ``fit_toas_batch`` callers get the
+    trace-time defaults (dense window on, bf16 off).
+    """
+    if cfg.err_dense_window >= 0 and cfg.mxu_bf16 >= 0:
+        return cfg
+    from crimp_tpu.ops import autotune
+
+    knobs = autotune.resolve_toafit(n_segments, n_events)
+    upd = {}
+    if cfg.err_dense_window < 0:
+        upd["err_dense_window"] = int(knobs["err_dense_window"])
+    if cfg.mxu_bf16 < 0:
+        upd["mxu_bf16"] = int(knobs["mxu_bf16"])
+    return cfg._replace(**upd)
+
+
 def fit_toas_batch_auto(
     kind: str,
     tpl: ProfileParams,
@@ -651,14 +759,15 @@ def fit_toas_batch_auto(
     communication (the distributed analog of the reference's serial per-ToA
     loop, measureToAs.py:168). Falls back to the plain single-device batch
     whenever sharding wouldn't help (few segments, one device)."""
-    import jax
-
     from crimp_tpu.parallel import mesh as pmesh
 
-    phases = np.asarray(phases)
-    masks = np.asarray(masks)
+    phases = np.asarray(phases, dtype=float)
+    masks = np.asarray(masks, dtype=bool)
     exposures = np.asarray(exposures, dtype=float)
     n_seg = phases.shape[0]
+    if n_seg == 0:
+        return {}
+    cfg = resolve_runtime_cfg(cfg, n_seg, phases.shape[1])
     n_devices = len(jax.devices()) if pmesh.sharding_enabled() else 1
     if n_devices < 2 or n_seg < n_devices:
         return fit_toas_batch(
@@ -684,19 +793,37 @@ def fit_toas_batch_auto(
     return {k: v[:n_seg] for k, v in out.items()}
 
 
+# Sortedness results keyed by array identity so repeated interval slicing of
+# the SAME event array (the measure_toas / GTI pattern) pays the O(n) check
+# once. The stored base-array reference keeps id() stable and valid; a
+# single-slot cache bounds memory to one retained event array.
+_SORTED_CACHE: dict[int, tuple[np.ndarray, bool]] = {}
+
+
+def _is_sorted_cached(times: np.ndarray) -> bool:
+    key = id(times)
+    hit = _SORTED_CACHE.get(key)
+    if hit is not None and hit[0] is times:
+        return hit[1]
+    ok = bool(np.all(np.diff(times) >= 0))
+    _SORTED_CACHE.clear()
+    _SORTED_CACHE[key] = (times, ok)
+    return ok
+
+
 def slice_sorted_intervals(times, starts, ends,
                            assume_sorted: bool = False) -> list[np.ndarray]:
     """Per-interval event segments of ``times`` over inclusive [start, end]
     windows (host helper).
 
-    Sorted input (one O(n) check unless the caller vouches with
-    ``assume_sorted``) gets O(log n) binary-search slices per interval;
-    unsorted input falls back to boolean masks — the intervals × events
-    product makes per-interval masks the dominant host cost of segment
-    prep on campaign-sized event lists."""
+    Sorted input (one O(n) check per distinct array — results are cached by
+    identity — unless the caller vouches with ``assume_sorted``) gets
+    O(log n) binary-search slices per interval; unsorted input falls back to
+    boolean masks — the intervals × events product makes per-interval masks
+    the dominant host cost of segment prep on campaign-sized event lists."""
     times = np.asarray(times)
     if not assume_sorted:
-        assume_sorted = bool(np.all(np.diff(times) >= 0))
+        assume_sorted = _is_sorted_cached(times)
     if assume_sorted:
         return [
             times[np.searchsorted(times, s, "left"):
@@ -736,10 +863,17 @@ def fit_toas_bucketed(
     ``max_pad_ratio``), each bucket runs one ``fit_toas_batch`` compile/
     execute, and results scatter back to the original order. Homogeneous
     inputs collapse to a single bucket — identical to the plain path.
+
+    The bucket loop is PIPELINED: each iteration pads bucket k+1 on the host
+    while the device still runs bucket k's fit — JAX async dispatch returns
+    unmaterialized device arrays immediately, and only a second pass calls
+    np.asarray (which blocks). Host prep therefore overlaps device compute
+    instead of serializing with it.
     """
     sizes = np.asarray([len(p) for p in phase_list])
     if len(phase_list) == 0:
         return {}
+    cfg = resolve_runtime_cfg(cfg, len(phase_list), int(sizes.max()))
     order = np.argsort(sizes, kind="stable")
     # bucket boundaries: next power of two of each segment size
     pow2 = 1 << np.ceil(np.log2(np.maximum(sizes[order], 1))).astype(int)
@@ -757,10 +891,18 @@ def fit_toas_bucketed(
         buckets.append(current)
 
     exposures = np.asarray(exposures, dtype=float)
-    out: dict[str, np.ndarray] = {}
+    # Pass 1 — dispatch: pad + enqueue every bucket's fit without touching
+    # the results (device arrays, still computing). Padding bucket k+1 runs
+    # while the device chews on bucket k.
+    pending: list[tuple[list[int], dict]] = []
     for bucket in buckets:
         phases, masks = pad_segments([phase_list[i] for i in bucket])
         res = fit_toas_batch_auto(kind, tpl, phases, masks, exposures[bucket], cfg)
+        pending.append((bucket, res))
+    # Pass 2 — materialize: np.asarray blocks on each device buffer in
+    # dispatch order and scatters back to the original segment order.
+    out: dict[str, np.ndarray] = {}
+    for bucket, res in pending:
         for key, val in res.items():
             arr = np.asarray(val)
             if key not in out:

@@ -86,30 +86,22 @@ def measure_toas(
     exposures = intervals["ToA_exposure"].to_numpy()
 
     idx_list = list(idx_range)
+    # one O(n) sortedness check, then every slice call gets the binary-search
+    # fast path without re-checking (FITS event lists are time-ordered)
+    times_sorted = bool(np.all(np.diff(times_all) >= 0))
     seg_times = toafit.slice_sorted_intervals(
-        times_all, starts[idx_list], ends[idx_list]
+        times_all, starts[idx_list], ends[idx_list], assume_sorted=times_sorted
     )
-    toa_mids = np.zeros(len(idx_list))
-    for out_i, (ii, t_seg) in enumerate(zip(idx_list, seg_times)):
+    for ii, t_seg in zip(idx_list, seg_times):
         if t_seg.size == 0:
             raise ValueError(f"ToA interval {ii} contains no events")
-        toa_mids[out_i] = (t_seg[-1] - t_seg[0]) / 2 + t_seg[0]
 
-    # One anchor per ToA interval: the fold of every segment is exact.
-    # All segments fold in a SINGLE device call (concatenated deltas with a
-    # per-event anchor index) so the kernel compiles once regardless of the
-    # per-interval event-count raggedness.
-    import jax.numpy as jnp
-
-    am = anchored.prepare_anchors(tm, toa_mids)
+    # One anchor per ToA interval: the fold of every segment is exact, and
+    # all segments fold in a SINGLE device call (anchored.fold_segments) so
+    # the kernel compiles once regardless of per-interval raggedness.
     seg_sizes = [t.size for t in seg_times]
-    anchor_idx = np.repeat(np.arange(len(seg_times)), seg_sizes)
-    delta_all = anchored.anchor_deltas(np.concatenate(seg_times), toa_mids, anchor_idx)
     with timed("anchored_fold"):
-        folded_all = np.asarray(
-            anchored.anchored_fold(am, jnp.asarray(delta_all), jnp.asarray(anchor_idx))
-        )
-    seg_phase_list = list(np.split(folded_all, np.cumsum(seg_sizes)[:-1]))
+        seg_phase_list, toa_mids = anchored.fold_segments(tm, seg_times)
     if kind in (profiles.CAUCHY, profiles.VONMISES):
         # radians convention for these families (measureToAs.py:195-200)
         seg_phase_list = [p * (2 * np.pi) for p in seg_phase_list]

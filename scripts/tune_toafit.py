@@ -11,6 +11,13 @@ Accuracy columns:
 - d_err: max |bound - bound_ref| in UNITS OF THE SCAN STEP (bounds are
   quantized to k*step + step/2, so any nonzero value is a real step flip)
 
+The ToA-engine knobs (err_dense_window, mxu_bf16) are also swept/A-B'd and
+the winners persisted into the autotune cache (like the search block sizes;
+``--no-persist`` opts out) so ``autotune.resolve_toafit()`` serves them to
+future runs at this problem scale. bf16 is only ever cached as ON when it
+is both measurably faster and its phShift deviation stays well under the
+error bars AND flips zero error-bound steps.
+
 Usage: python scripts/tune_toafit.py [--events 10000] [--res 1000]
 Run on the accelerator for defaults that matter (CPU ratios differ).
 """
@@ -37,6 +44,9 @@ def main():
     ap.add_argument("--segments", type=int, default=84)
     ap.add_argument("--res", type=int, default=1000)
     ap.add_argument("--repeat", type=int, default=2)
+    ap.add_argument("--no-persist", dest="persist", action="store_false",
+                    help="do not write the tuned ToA-engine knobs "
+                         "(err_dense_window, mxu_bf16) to the autotune cache")
 
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
     from crimp_tpu.utils.platform import add_cpu_flag, force_cpu_platform
@@ -102,6 +112,10 @@ def main():
         "err_chunk": [16, 32, 64, 128],
         "n_brute": [48, 96, 128, 256],
         "brute_chunk": [32, 64, 128],
+        # dense error-scan first window (steps per side): 0 = pure
+        # while_loop path; any value is bit-identical (d_err must read 0 on
+        # every row — a nonzero value is a BUG, not a tuning tradeoff)
+        "err_dense_window": [0, 8, 16, 32, 64, 128],
     }
     # pivot around the SHIPPED defaults so each row corresponds to a
     # configuration a default-config user actually runs
@@ -150,6 +164,18 @@ def main():
     log(f"[tune] grid-refine defaults: {wall_grid:.2f}s, d_phi={d_phi_grid:.2e}, "
         f"d_err={d_err_grid} steps")
 
+    # bf16 MXU profile-sweep A/B: shipped defaults with bf16 operands / f32
+    # accumulation in the Fourier matmul. Accuracy is judged against the
+    # EXACT shipped-defaults fit (the deviation the bf16 switch itself
+    # introduces), not the high-effort reference.
+    wall_bf16, out_bf16 = timed(
+        toafit.ToAFitConfig(kind=kind, ph_shift_res=args.res, mxu_bf16=1)
+    )
+    d_phi_bf16, d_err_bf16 = accuracy(out_bf16, out_def)
+    median_err = float(np.median(out_def["phShift_UL"]))
+    log(f"[tune] bf16 sweeps: {wall_bf16:.2f}s, d_phi={d_phi_bf16:.2e} "
+        f"(median error bar {median_err:.2e}), d_err={d_err_bf16} steps")
+
     results = []
     # axis-by-axis sweep around the current defaults (full product would be
     # 192 compiles); each axis varies alone
@@ -167,6 +193,36 @@ def main():
             log(f"[tune] {axis}={v}: {row['wall_s']}s, d_phi={d_phi:.2e}, "
                 f"d_err={d_err} steps")
 
+    # -- learn the ToA-engine knobs and persist them like block sizes ------
+    # dense window: fastest swept value whose bounds stayed bit-identical
+    # (they all must — a nonzero d_err row is excluded AND worth a bug
+    # report); bf16: only if faster by >1.2x with deviation well under the
+    # error bars and zero error-bound step flips.
+    window_rows = [r for r in results
+                   if r["axis"] == "err_dense_window" and r["d_err_steps"] == 0]
+    best_window = (
+        max(window_rows, key=lambda r: r["toas_per_sec"])["value"]
+        if window_rows else toafit.DENSE_WINDOW_DEFAULT
+    )
+    bf16_wins = bool(
+        wall_bf16 * 1.2 < wall_def
+        and d_phi_bf16 < 0.1 * median_err
+        and d_err_bf16 == 0
+    )
+    tuned = {
+        "err_dense_window": int(best_window),
+        "mxu_bf16": int(bf16_wins),
+        "toas_per_sec": round(args.segments / (wall_bf16 if bf16_wins else wall_def), 1),
+        "bf16_d_phi_rad": d_phi_bf16,
+        "median_err_rad": median_err,
+    }
+    if args.persist:
+        from crimp_tpu.ops import autotune
+
+        autotune.store_toafit(args.segments, args.events, tuned)
+        log(f"[tune] persisted ToA-engine knobs for this scale: "
+            f"err_dense_window={best_window}, mxu_bf16={int(bf16_wins)}")
+
     print(json.dumps({
         "reference_wall_s": round(ref_wall, 3),
         "shipped_defaults": {**defaults, "wall_s": round(wall_def, 3),
@@ -179,8 +235,14 @@ def main():
             "wall_s": round(wall_grid, 3),
             "d_phi_rad": d_phi_grid, "d_err_steps": d_err_grid,
         },
+        "mxu_bf16": {
+            "wall_s": round(wall_bf16, 3),
+            "d_phi_rad": d_phi_bf16, "d_err_steps": d_err_bf16,
+            "median_err_rad": median_err,
+        },
+        "tuned": {**tuned, "persisted": bool(args.persist)},
         "rows": results,
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -32,6 +32,8 @@ def tuner_cache(tmp_path, monkeypatch):
     monkeypatch.setenv("CRIMP_TPU_AUTOTUNE_CACHE", str(path))
     monkeypatch.delenv("CRIMP_TPU_AUTOTUNE", raising=False)
     monkeypatch.delenv("CRIMP_TPU_GRID_BLOCKS", raising=False)
+    monkeypatch.delenv("CRIMP_TPU_TOA_DENSE_WINDOW", raising=False)
+    monkeypatch.delenv("CRIMP_TPU_MXU_BF16", raising=False)
     return path
 
 
@@ -292,3 +294,94 @@ print(json.dumps({"wall": time.perf_counter() - t0, **c}))
         assert plat.compilation_cache_dir() == tmp_path / "jc"
         assert plat.configure_compilation_cache() == tmp_path / "jc"
         assert (tmp_path / "jc").is_dir()
+
+
+class TestResolveToafit:
+    """ToA-engine knob resolution (err_dense_window, mxu_bf16): env hard
+    overrides > cached tuner winner (unless autotune off) > static
+    defaults; never any implicit timing."""
+
+    def test_defaults_when_nothing_cached(self, tuner_cache):
+        from crimp_tpu.ops import toafit
+
+        out = autotune.resolve_toafit(84, 10_000)
+        assert out == {"err_dense_window": toafit.DENSE_WINDOW_DEFAULT,
+                       "mxu_bf16": 0}
+
+    def test_cached_winner_used_in_auto_mode(self, tuner_cache):
+        autotune.store_toafit(84, 10_000,
+                              {"err_dense_window": 64, "mxu_bf16": 1},
+                              tuner_cache)
+        out = autotune.resolve_toafit(84, 10_000)
+        assert out == {"err_dense_window": 64, "mxu_bf16": 1}
+        # size bucketing: 9000 events shares the 10k bucket, 100k does not
+        assert autotune.resolve_toafit(84, 9_000)["err_dense_window"] == 64
+        assert autotune.resolve_toafit(84, 100_000)["mxu_bf16"] == 0
+
+    def test_off_mode_ignores_cache_but_honors_env(
+            self, tuner_cache, monkeypatch):
+        from crimp_tpu.ops import toafit
+
+        autotune.store_toafit(84, 10_000,
+                              {"err_dense_window": 64, "mxu_bf16": 1},
+                              tuner_cache)
+        monkeypatch.setenv("CRIMP_TPU_AUTOTUNE", "0")
+        out = autotune.resolve_toafit(84, 10_000)
+        assert out == {"err_dense_window": toafit.DENSE_WINDOW_DEFAULT,
+                       "mxu_bf16": 0}
+        # the env knobs stay hard overrides even with autotune off
+        monkeypatch.setenv("CRIMP_TPU_TOA_DENSE_WINDOW", "16")
+        monkeypatch.setenv("CRIMP_TPU_MXU_BF16", "1")
+        assert autotune.resolve_toafit(84, 10_000) == {
+            "err_dense_window": 16, "mxu_bf16": 1}
+
+    def test_env_beats_cached_winner(self, tuner_cache, monkeypatch):
+        autotune.store_toafit(84, 10_000,
+                              {"err_dense_window": 64, "mxu_bf16": 1},
+                              tuner_cache)
+        monkeypatch.setenv("CRIMP_TPU_TOA_DENSE_WINDOW", "0")
+        out = autotune.resolve_toafit(84, 10_000)
+        assert out["err_dense_window"] == 0  # env wins
+        assert out["mxu_bf16"] == 1  # the un-overridden knob still cached
+
+    def test_env_malformed_raises(self, tuner_cache, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_TOA_DENSE_WINDOW", "many")
+        with pytest.raises(ValueError, match="CRIMP_TPU_TOA_DENSE_WINDOW"):
+            autotune.resolve_toafit(84, 10_000)
+        monkeypatch.delenv("CRIMP_TPU_TOA_DENSE_WINDOW")
+        # bf16 is a strict 0/1 switch: 2 is a typo, not a request
+        monkeypatch.setenv("CRIMP_TPU_MXU_BF16", "2")
+        with pytest.raises(ValueError, match="CRIMP_TPU_MXU_BF16"):
+            autotune.resolve_toafit(84, 10_000)
+
+    def test_malformed_entry_rejected(self, tuner_cache):
+        from crimp_tpu.ops import toafit
+
+        autotune.store_toafit(84, 10_000,
+                              {"err_dense_window": "wide", "mxu_bf16": 3},
+                              tuner_cache)
+        assert autotune.cached_toafit(84, 10_000) is None
+        out = autotune.resolve_toafit(84, 10_000)
+        assert out == {"err_dense_window": toafit.DENSE_WINDOW_DEFAULT,
+                       "mxu_bf16": 0}
+
+    def test_device_fingerprint_invalidates(self, tuner_cache, monkeypatch):
+        autotune.store_toafit(84, 10_000,
+                              {"err_dense_window": 64, "mxu_bf16": 1},
+                              tuner_cache)
+        monkeypatch.setattr(autotune, "device_fingerprint",
+                            lambda: ("tpu", "TPU v9"))
+        assert autotune.cached_toafit(84, 10_000) is None
+        assert autotune.resolve_toafit(84, 10_000)["mxu_bf16"] == 0
+
+    def test_cache_failure_degrades_to_defaults(self, tuner_cache,
+                                                monkeypatch):
+        from crimp_tpu.ops import toafit
+
+        def boom(*a, **k):
+            raise RuntimeError("backend exploded")
+
+        monkeypatch.setattr(autotune, "cached_toafit", boom)
+        out = autotune.resolve_toafit(84, 10_000)
+        assert out == {"err_dense_window": toafit.DENSE_WINDOW_DEFAULT,
+                       "mxu_bf16": 0}

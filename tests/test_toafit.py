@@ -356,3 +356,211 @@ class TestBucketedFit:
         out = toafit.fit_toas_bucketed(kind, tpl, segs, exps, cfg)
         assert out["phShift"].shape == (3,)
         assert np.isfinite(out["phShift"]).all()
+
+
+class TestDenseErrorScan:
+    """The dense first-window error scan must be BIT-identical to the pure
+    chunked while_loop path: the window knob only moves work between the
+    one-shot dense sweep and the serial fallback loop (PR 2 tentpole)."""
+
+    @pytest.fixture(scope="class")
+    def workload(self):
+        rng = np.random.RandomState(21)
+        kind = profiles.FOURIER
+        tpl = template(kind)
+        phases = draw_phases(kind, tpl, 3000, rng, ph_shift=0.25)
+        return kind, tpl, phases
+
+    def _fit(self, workload, **cfg_kw):
+        kind, tpl, phases = workload
+        return fit_one(kind, tpl, phases, 3000 / 17.0,
+                       ph_shift_res=1000, err_chunk=8, **cfg_kw)
+
+    def test_dense_bitwise_identical_to_loop(self, workload):
+        """Crossing case: this workload's 1-sigma bound sits at k* = 9
+        steps, so W=16 covers it densely while W=4 needs the fallback —
+        every variant must agree BITWISE with the pure loop."""
+        loop = self._fit(workload, err_dense_window=0)
+        assert loop["errScanLoopIters"] > 0  # pure loop really looped
+        for w in (4, 16, toafit.DENSE_WINDOW_DEFAULT):
+            dense = self._fit(workload, err_dense_window=w)
+            assert dense["phShift_LL"] == loop["phShift_LL"], w
+            assert dense["phShift_UL"] == loop["phShift_UL"], w
+            assert dense["phShift"] == loop["phShift"]
+
+    def test_default_window_covers_common_case(self, workload):
+        """W=32 default must cover this typical bound (k*=9) without any
+        fallback while_loop body — the no-serial-loop acceptance check."""
+        dense = self._fit(workload)  # err_dense_window=-1 -> default 32
+        assert dense["errScanLoopIters"] == 0
+
+    def test_small_window_falls_back_and_still_matches(self, workload):
+        """W=4 < k*=9: the fallback loop must engage (iters > 0) yet the
+        bounds stay bitwise equal — chunk alignment after the window
+        cannot move the first crossing."""
+        loop = self._fit(workload, err_dense_window=0)
+        small = self._fit(workload, err_dense_window=4)
+        assert small["errScanLoopIters"] > 0
+        assert small["errScanLoopIters"] < loop["errScanLoopIters"]
+        assert small["phShift_LL"] == loop["phShift_LL"]
+        assert small["phShift_UL"] == loop["phShift_UL"]
+
+    def test_saturating_scan_identical_on_all_paths(self):
+        """No-crossing case: a flat profile (ampShift ~ 0 kills the shape
+        term, so the LL never drops) must saturate both sides at
+        (max_k+1)*step + step/2 on the dense, partial-window and pure-loop
+        paths alike."""
+        kind = profiles.FOURIER
+        tpl = template(kind).replace(amp_shift=jnp.asarray(1e-9))
+        rng = np.random.RandomState(5)
+        phases = rng.uniform(0, 1, 500)
+        res = 40
+        step = 2 * np.pi / res
+        saturated = (res // 2 + 1) * step + step / 2
+        outs = {
+            w: fit_one(kind, tpl, phases, 500 / 17.0,
+                       ph_shift_res=res, err_chunk=4, err_dense_window=w)
+            for w in (0, 2, -1)
+        }
+        for w, out in outs.items():
+            assert np.isclose(out["phShift_LL"], saturated), w
+            assert out["phShift_LL"] == outs[0]["phShift_LL"]
+            assert out["phShift_UL"] == outs[0]["phShift_UL"]
+        # default window W=min(32, 20)=20 covers the whole scan: no loop
+        assert outs[-1]["errScanLoopIters"] == 0
+        assert outs[0]["errScanLoopIters"] > 0
+
+    def test_vmapped_mixed_segments_match_solo_fits(self):
+        """A batch mixing tight and saturating segments (per-lane loop
+        demand differs) must return exactly what each segment gets alone —
+        the while_loop's per-lane select cannot leak across lanes."""
+        kind = profiles.FOURIER
+        tpl = template(kind)
+        rng = np.random.RandomState(31)
+        segs = [
+            draw_phases(kind, tpl, 2500, rng, ph_shift=0.3),   # tight bound
+            draw_phases(kind, tpl, 400, rng, ph_shift=-0.2),   # wide bound
+            draw_phases(kind, tpl, 1200, rng, ph_shift=0.0),
+        ]
+        n_max = max(len(s) for s in segs)
+        phases = np.zeros((3, n_max))
+        masks = np.zeros((3, n_max), dtype=bool)
+        for i, s in enumerate(segs):
+            phases[i, : len(s)] = s
+            masks[i, : len(s)] = True
+        exps = jnp.asarray([len(s) / 17.0 for s in segs])
+        cfg = toafit.ToAFitConfig(kind=kind, ph_shift_res=400, err_chunk=4,
+                                  err_dense_window=2)
+        batch = toafit.fit_toas_batch(
+            kind, tpl, jnp.asarray(phases), jnp.asarray(masks), exps, cfg)
+        for i, s in enumerate(segs):
+            solo = fit_one(kind, tpl, s, len(s) / 17.0, ph_shift_res=400,
+                           err_chunk=4, err_dense_window=2)
+            assert float(batch["phShift_LL"][i]) == solo["phShift_LL"], i
+            assert float(batch["phShift_UL"][i]) == solo["phShift_UL"], i
+            assert int(batch["errScanLoopIters"][i]) == solo["errScanLoopIters"], i
+
+
+class TestMxuBf16:
+    """bf16 MXU profile sweeps: off must be bit-identical to today's path;
+    on must deviate well under the error bars (CPU emulates the same
+    bf16 rounding, so the bound is meaningful everywhere)."""
+
+    @pytest.fixture(scope="class")
+    def workload(self):
+        rng = np.random.RandomState(77)
+        kind = profiles.FOURIER
+        tpl = template(kind)
+        phases = draw_phases(kind, tpl, 4000, rng, ph_shift=0.4)
+        return kind, tpl, phases
+
+    def test_bf16_off_is_bitwise_default(self, workload):
+        kind, tpl, phases = workload
+        default = fit_one(kind, tpl, phases, 4000 / 17.0)  # mxu_bf16=-1
+        exact = fit_one(kind, tpl, phases, 4000 / 17.0, mxu_bf16=0)
+        for key in ("phShift", "phShift_LL", "phShift_UL", "logLmax", "norm"):
+            assert default[key] == exact[key], key
+
+    def test_bf16_deviation_well_under_error_bar(self, workload):
+        kind, tpl, phases = workload
+        exact = fit_one(kind, tpl, phases, 4000 / 17.0, mxu_bf16=0)
+        bf16 = fit_one(kind, tpl, phases, 4000 / 17.0, mxu_bf16=1)
+        err = max(exact["phShift_UL"], exact["phShift_LL"])
+        dev = abs(bf16["phShift"] - exact["phShift"])
+        # headline gate in bench.py/tune_toafit.py is dev < 0.1*err; the
+        # test allows 0.5*err so sampler-seed drift cannot flake it while
+        # still catching a broken bf16 path (which lands at O(err) or NaN)
+        assert dev < 0.5 * err, (dev, err)
+        assert np.isfinite(bf16["logLmax"])
+
+
+class TestRuntimeCfgResolution:
+    def test_explicit_cfg_skips_autotune(self, monkeypatch):
+        """Both knobs >= 0: resolve_runtime_cfg must not even import/consult
+        the autotune layer (host wrappers run per call — a cache read per
+        bucket would be wasted work)."""
+        from crimp_tpu.ops import autotune
+
+        def boom(*a, **k):  # pragma: no cover - failing is the assertion
+            raise AssertionError("resolve_toafit consulted for explicit cfg")
+
+        monkeypatch.setattr(autotune, "resolve_toafit", boom)
+        cfg = toafit.ToAFitConfig(err_dense_window=8, mxu_bf16=0)
+        assert toafit.resolve_runtime_cfg(cfg, 4, 1000) is cfg
+
+    def test_sentinels_filled_from_resolver(self, monkeypatch):
+        from crimp_tpu.ops import autotune
+
+        monkeypatch.setattr(
+            autotune, "resolve_toafit",
+            lambda s, e: {"err_dense_window": 11, "mxu_bf16": 1})
+        cfg = toafit.resolve_runtime_cfg(toafit.ToAFitConfig(), 4, 1000)
+        assert cfg.err_dense_window == 11
+        assert cfg.mxu_bf16 == 1
+        # partially explicit: only the -1 sentinel resolves
+        cfg2 = toafit.resolve_runtime_cfg(
+            toafit.ToAFitConfig(err_dense_window=0), 4, 1000)
+        assert cfg2.err_dense_window == 0
+        assert cfg2.mxu_bf16 == 1
+
+    def test_zero_segment_batch_returns_empty(self):
+        kind = profiles.FOURIER
+        tpl = template(kind)
+        out = toafit.fit_toas_batch_auto(
+            kind, tpl, np.zeros((0, 8)), np.zeros((0, 8), dtype=bool),
+            np.zeros(0), toafit.ToAFitConfig())
+        assert out == {}
+
+
+class TestSortedCache:
+    def test_sortedness_check_cached_by_identity(self, monkeypatch):
+        times = np.sort(np.random.RandomState(0).uniform(0, 100, 5000))
+        calls = {"n": 0}
+        real_diff = np.diff
+
+        def counting_diff(*a, **k):
+            calls["n"] += 1
+            return real_diff(*a, **k)
+
+        monkeypatch.setattr(toafit.np, "diff", counting_diff)
+        toafit._SORTED_CACHE.clear()
+        segs = toafit.slice_sorted_intervals(times, [10.0, 50.0], [20.0, 60.0])
+        assert calls["n"] == 1
+        # same array again: cache hit, no second O(n) pass
+        toafit.slice_sorted_intervals(times, [30.0], [40.0])
+        assert calls["n"] == 1
+        # a DIFFERENT array re-checks (id reuse is guarded by identity)
+        other = times[::-1].copy()
+        toafit.slice_sorted_intervals(other, [10.0], [20.0])
+        assert calls["n"] == 2
+        for seg in segs:
+            assert np.all((seg >= 10.0) & (seg <= 60.0))
+
+    def test_assume_sorted_skips_check(self, monkeypatch):
+        times = np.arange(100, dtype=float)
+        monkeypatch.setattr(
+            toafit, "_is_sorted_cached",
+            lambda t: (_ for _ in ()).throw(AssertionError("checked")))
+        segs = toafit.slice_sorted_intervals(
+            times, [5.0], [10.0], assume_sorted=True)
+        np.testing.assert_array_equal(segs[0], np.arange(5.0, 11.0))
