@@ -37,6 +37,25 @@ import numpy as np
 
 REFERENCE_TOAS_PER_SEC = 84 / 202.0  # data/ToAs_2259.log timestamps
 
+# Timed-region version tags: they version the WORK inside each timed
+# region, so a recorded rate is only ever compared against records
+# carrying the same tag. PR 2 moved interval slicing from O(n) masks to
+# a binary search INSIDE the ToA timed region — comparing the next
+# on-chip number against the pre-change 24.5 ToA/s baseline without a
+# region tag would silently mix the two definitions. Bump on any change
+# to what a timed region covers.
+TOA_TIMED_REGION = "toa_v2_sorted_slices"
+Z2_TIMED_REGION = "z2_grid_v1"
+
+# Promotion gate for the factorized (matmul) grid kernels, same shape as
+# the bf16 gate: >1.2x measured speedup AND max statistic deviation under
+# this fraction of the statistic's own noise scale (std of a chi^2 with
+# 2*nharm dof = sqrt(4*nharm)) AND an identical argmax. The budget matches
+# the derived bound in docs/performance.md (reseed-stride recurrence drift
+# below the poly-trig floor).
+GRID_MXU_SPEEDUP_GATE = 1.2
+GRID_MXU_DEV_BUDGET = 0.01  # fraction of sqrt(4*nharm)
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -386,6 +405,7 @@ def bench_toas(par_path: str, intervals_path: str, template_path: str, times: np
     wall = time.perf_counter() - t0
     return {
         "wall_s": wall,
+        "timed_region": TOA_TIMED_REGION,
         "toas_per_sec": n_toas / wall,
         "n_toas": n_toas,
         "median_abs_phshift": float(np.median(np.abs(fit["phShift"]))),
@@ -445,6 +465,7 @@ def bench_z2(times: np.ndarray, n_trials: int = 100_000) -> dict:
     wall = time.perf_counter() - t0
     out = {
         "wall_s": wall,
+        "timed_region": Z2_TIMED_REGION,
         "trials_per_sec": n_trials / wall,
         "n_events": len(sec),
         "peak": float(power.max()),
@@ -482,6 +503,86 @@ def bench_z2(times: np.ndarray, n_trials: int = 100_000) -> dict:
         return z2_power_grid_pallas(sec, f0, df, n_trials, 2)
 
     ab("Pallas", "pallas", pallas_run)
+    return out
+
+
+def bench_grid_mxu(times: np.ndarray, n_trials: int = 100_000,
+                   n_fdot: int = 8, nharm: int = 2,
+                   persist: bool = True) -> dict:
+    """Dense-vs-factorized grid kernel A/B (1-D and 2-D) with the bf16-style
+    promotion gate: the factorized path is only cached as the winner when it
+    is >1.2x faster AND its max statistic deviation stays under the
+    documented budget AND the argmax is identical. The gated winner (1 or 0)
+    persists through autotune.store_grid_mxu so library calls at this
+    workload bucket pick it up with zero timing runs."""
+    from crimp_tpu.ops import autotune, search
+
+    sec = (times - times.mean()) * 86400.0
+    freqs = np.linspace(0.1430, 0.1436, n_trials)
+    f0, df = search.uniform_grid(freqs)
+    fdots = -(10.0 ** np.linspace(-14.5, -13.5, n_fdot))
+    reseed = autotune.GRID_MXU_RESEED_DEFAULT
+    noise_scale = float(np.sqrt(4 * nharm))  # std of a chi^2_{2*nharm}
+
+    def rate_of(fn):
+        np.asarray(fn())  # compile
+        t0 = time.perf_counter()
+        power = np.asarray(fn())
+        return n_trials / (time.perf_counter() - t0), power
+
+    out: dict = {
+        "nharm": nharm, "n_fdot": n_fdot, "reseed": reseed,
+        "dev_budget_frac": GRID_MXU_DEV_BUDGET,
+    }
+    rate_1d, p_exact = rate_of(
+        lambda: search.z2_power_grid(sec, f0, df, n_trials, nharm, mxu=False))
+    rate_1d_mxu, p_mxu = rate_of(
+        lambda: search.z2_power_grid(sec, f0, df, n_trials, nharm, mxu=True,
+                                     reseed=reseed, mxu_bf16=False))
+    out["trials_per_sec_1d_exact"] = rate_1d
+    out["trials_per_sec_1d_mxu"] = rate_1d_mxu
+    out["dev_frac_1d"] = float(np.max(np.abs(p_mxu - p_exact))) / noise_scale
+    out["argmax_identical_1d"] = bool(np.argmax(p_mxu) == np.argmax(p_exact))
+    log(f"[bench] grid_mxu 1-D: exact {rate_1d:.0f} vs factorized "
+        f"{rate_1d_mxu:.0f} trials/s, dev {out['dev_frac_1d']:.2e} of noise")
+
+    rate_2d, p2_exact = rate_of(
+        lambda: search.z2_power_2d_grid(sec, f0, df, n_trials // n_fdot,
+                                        fdots, nharm, mxu=False))
+    rate_2d_mxu, p2_mxu = rate_of(
+        lambda: search.z2_power_2d_grid(sec, f0, df, n_trials // n_fdot,
+                                        fdots, nharm, mxu=True,
+                                        reseed=reseed, mxu_bf16=False))
+    out["trials_per_sec_2d_exact"] = rate_2d
+    out["trials_per_sec_2d_mxu"] = rate_2d_mxu
+    out["dev_frac_2d"] = float(np.max(np.abs(p2_mxu - p2_exact))) / noise_scale
+    out["argmax_identical_2d"] = bool(
+        np.argmax(p2_mxu) == np.argmax(p2_exact))
+    log(f"[bench] grid_mxu 2-D: exact {rate_2d:.0f} vs factorized "
+        f"{rate_2d_mxu:.0f} trials/s, dev {out['dev_frac_2d']:.2e} of noise")
+
+    promoted = bool(
+        rate_1d_mxu > GRID_MXU_SPEEDUP_GATE * rate_1d
+        and rate_2d_mxu > GRID_MXU_SPEEDUP_GATE * rate_2d
+        and out["dev_frac_1d"] < GRID_MXU_DEV_BUDGET
+        and out["dev_frac_2d"] < GRID_MXU_DEV_BUDGET
+        and out["argmax_identical_1d"]
+        and out["argmax_identical_2d"]
+    )
+    out["promoted"] = promoted
+    out["persisted"] = False
+    if persist:
+        try:
+            autotune.store_grid_mxu(False, len(sec), n_trials, {
+                "grid_mxu": int(promoted), "reseed": reseed, "mxu_bf16": 0,
+                "trials_per_sec_exact": round(rate_2d, 1),
+                "trials_per_sec_mxu": round(rate_2d_mxu, 1),
+            })
+            out["persisted"] = True
+        except Exception as exc:  # noqa: BLE001 - persistence is best-effort
+            log(f"[bench] grid_mxu winner not persisted: {exc}")
+    log(f"[bench] grid_mxu gate: promoted={promoted} "
+        f"(>1.2x both + dev under {GRID_MXU_DEV_BUDGET} + argmax identical)")
     return out
 
 
@@ -667,10 +768,20 @@ def main():
     # Rates stay labeled; absolute wall-clock fields are only claimed
     # against the target on an accelerator.
     on_cpu = platform == "cpu"
-    events_per_toa = 2_000 if on_cpu else 10_000
-    z2_trials = 2_000 if on_cpu else 100_000
-    ns_freq, ns_fdot = (250, 8) if on_cpu else (2500, 40)
-    cfg4_segments, cfg4_events = (100, 1_000) if on_cpu else (500, 2_000)
+    # CRIMP_TPU_BENCH_SCALE < 1 shrinks every workload (with floors that
+    # keep each stage meaningful) so the end-to-end time-envelope test can
+    # drive the full worst-case path inside a simulated driver budget.
+    scale = float(os.environ.get("CRIMP_TPU_BENCH_SCALE", "1.0"))
+
+    def scaled(base: int, floor: int) -> int:
+        return max(int(base * scale), floor)
+
+    events_per_toa = scaled(2_000 if on_cpu else 10_000, 200)
+    z2_trials = scaled(2_000 if on_cpu else 100_000, 256)
+    ns_freq = scaled(250 if on_cpu else 2500, 64)
+    ns_fdot = scaled(8 if on_cpu else 40, 2)
+    cfg4_segments = scaled(100 if on_cpu else 500, 8)
+    cfg4_events = scaled(1_000 if on_cpu else 2_000, 200)
 
     errors: dict[str, str] = {}
 
@@ -715,6 +826,9 @@ def main():
     if z2:
         log(f"[bench] Z^2 {z2_trials} trials x {z2['n_events']} events: {z2['wall_s']:.2f}s "
             f"({z2['trials_per_sec']:.0f} trials/s), peak {z2['peak']:.0f} at {z2['peak_freq']:.6f} Hz")
+
+    grid_mxu = step("grid_mxu", bench_grid_mxu, times,
+                    n_trials=z2_trials, n_fdot=4 if on_cpu else 8)
 
     toas = step("toas", bench_toas, par, intervals_path, template, times, intervals)
     if toas:
@@ -764,6 +878,8 @@ def main():
         "north_star_under_10s": (
             bool(north and north["wall_s"] < 10.0) and not on_cpu
         ),
+        "toa_timed_region": toas["timed_region"] if toas else TOA_TIMED_REGION,
+        "z2_timed_region": z2["timed_region"] if z2 else Z2_TIMED_REGION,
         "z2_trials_per_sec": round(z2["trials_per_sec"], 1) if z2 else None,
         "z2_trials_per_sec_poly": (
             round(z2["trials_per_sec_poly"], 1)
@@ -780,6 +896,9 @@ def main():
         "config4_toas_per_sec": round(cfg4["toas_per_sec"], 1) if cfg4 else None,
         "config4_recovered_frac": cfg4["recovered_frac"] if cfg4 else None,
         "warmup_s": warm["warmup_s"] if warm else None,
+        # dense-vs-factorized grid kernel A/B (1-D and 2-D) with its
+        # promotion gate; the gated winner persists in the autotune cache
+        "grid_mxu_ab": grid_mxu,
         # ToA-engine A/B: dense vs loop error scan (bit-identical bounds
         # asserted), bf16 vs f32 profile sweep (deviation-gated headline use)
         "toa_engine_ab": toas["engine_ab"] if toas else None,

@@ -22,10 +22,12 @@ Cache key schema (one JSON file, atomic tmp+rename writes)::
     <platform>|<device_kind>|<kernel>|poly<0/1>|ev<ceil log2 n_events>|tr<ceil log2 n_trials>
 
 ``kernel`` is the variant family: "grid" (uniform-grid fast path, also
-used by the 2-D grid kernel — same inner tile structure) or "general"
-(arbitrary-frequency blockwise kernel). Problem sizes are bucketed to
-their ceil-log2 so a 7.9e5-event scan and an 8.1e5-event scan share a
-tuning, while 1e5 and 1e8 do not.
+used by the 2-D grid kernel — same inner tile structure), "grid_mxu"
+(the factorized matmul variant — its block optimum is MXU-shaped, not
+VPU-shaped, so it gets its own entries) or "general" (arbitrary-frequency
+blockwise kernel). Problem sizes are bucketed to their ceil-log2 so a
+7.9e5-event scan and an 8.1e5-event scan share a tuning, while 1e5 and
+1e8 do not.
 
 Env knobs:
 
@@ -196,7 +198,7 @@ def resolve_blocks(kernel: str, n_events: int, n_trials: int,
     miss (only when CRIMP_TPU_AUTOTUNE=1) > static module defaults.
     Never runs timing unless eager mode is opted into.
     """
-    if kernel not in ("grid", "general"):
+    if kernel not in ("grid", "grid_mxu", "general"):
         raise ValueError(f"unknown kernel variant {kernel!r}")
     if event_block is not None and trial_block is not None:
         return int(event_block), int(trial_block)
@@ -320,6 +322,79 @@ def resolve_toafit(n_segments: int, n_events: int) -> dict:
             out.update(cached)
     if env_w is not None:
         out["err_dense_window"] = env_w
+    if env_b is not None:
+        out["mxu_bf16"] = env_b
+    return out
+
+
+# -- factorized grid-kernel knob (grid_mxu) ---------------------------------
+#
+# CRIMP_TPU_GRID_MXU switches the uniform-grid kernels between the exact
+# per-pair sincos path and the factorized angle-addition matmul path
+# (ops/search.py harmonic_sums_uniform{,_2d}_mxu). Like bf16, the switch
+# is accuracy-gated: only bench.py's deviation-checked A/B ever caches a
+# 1, and the env var stays a hard override in both directions. The cache
+# entry also carries the tuned reseed stride of the j_lo recurrence and
+# whether the bf16 operand mode won alongside it. The cache key uses the
+# kernel name "grid_mxu_enable" so the on/off entry can never collide
+# with the "grid_mxu" BLOCK-size entries resolve_blocks() maintains.
+
+GRID_MXU_ENV = "CRIMP_TPU_GRID_MXU"
+GRID_MXU_RESEED_DEFAULT = 64
+
+
+def grid_mxu_defaults() -> dict:
+    return {"grid_mxu": 0, "reseed": GRID_MXU_RESEED_DEFAULT, "mxu_bf16": 0}
+
+
+def grid_mxu_cache_key(poly: bool, n_events: int, n_trials: int,
+                       platform: str | None = None,
+                       device_kind: str | None = None) -> str:
+    return cache_key("grid_mxu_enable", poly, n_events, n_trials,
+                     platform=platform, device_kind=device_kind)
+
+
+def cached_grid_mxu(poly: bool, n_events: int, n_trials: int) -> dict | None:
+    entry = _load_cache().get(grid_mxu_cache_key(poly, n_events, n_trials))
+    if not isinstance(entry, dict):
+        return None
+    m, r, b = entry.get("grid_mxu"), entry.get("reseed"), entry.get("mxu_bf16")
+    if m in (0, 1) and isinstance(r, int) and r > 0 and b in (0, 1):
+        return {"grid_mxu": m, "reseed": r, "mxu_bf16": b}
+    return None
+
+
+def store_grid_mxu(poly: bool, n_events: int, n_trials: int, entry: dict,
+                   path: pathlib.Path | None = None) -> None:
+    """Persist a gated grid_mxu A/B winner (bench.py calls this)."""
+    _store_entry(grid_mxu_cache_key(poly, n_events, n_trials), entry, path)
+
+
+def resolve_grid_mxu(n_events: int, n_trials: int, poly: bool = False) -> dict:
+    """Resolve {grid_mxu, reseed, mxu_bf16} for a uniform-grid search.
+
+    Precedence: CRIMP_TPU_GRID_MXU (hard override in both directions,
+    honored even with autotune off; malformed raises) > cached A/B winner
+    (unless CRIMP_TPU_AUTOTUNE=0) > default off. Never times anything —
+    the A/B with its accuracy gate lives in bench.py, exactly like the
+    bf16 knob's tune_toafit.py discipline. CRIMP_TPU_MXU_BF16 composes as
+    the operand-precision override when the factorized path is on.
+    """
+    out = grid_mxu_defaults()
+    env_m = _env_nonneg_int(GRID_MXU_ENV, valid=(0, 1))
+    env_b = _env_nonneg_int(MXU_BF16_ENV, valid=(0, 1))
+    if autotune_mode() != "off":
+        try:
+            cached = cached_grid_mxu(poly, n_events, n_trials)
+        except Exception:  # noqa: BLE001 — a corrupt cache or an
+            # uninitializable backend must never take down a search call
+            logger.warning("grid_mxu autotune cache lookup failed; using "
+                           "static defaults", exc_info=True)
+            cached = None
+        if cached:
+            out.update(cached)
+    if env_m is not None:
+        out["grid_mxu"] = env_m
     if env_b is not None:
         out["mxu_bf16"] = env_b
     return out

@@ -111,7 +111,26 @@ class ResumableScan:
         # modes: every chunk of a store is computed under one tiling.
         from crimp_tpu.ops import autotune
 
-        kernel = "grid" if self._fastpath else "general"
+        # The factorized-kernel knob is numeric mode too (the matmul path
+        # has its own deviation budget), so it resolves once and pins like
+        # poly/fastpath: [on/off, reseed stride, bf16 operands].
+        self._mxu_explicit = autotune._env_nonneg_int(
+            autotune.GRID_MXU_ENV, valid=(0, 1)) is not None
+        if self._fastpath:
+            r = autotune.resolve_grid_mxu(
+                len(self.times), min(len(self.freqs), self.chunk_trials),
+                poly=self.poly)
+            self._mxu = bool(r["grid_mxu"])
+            self._mxu_reseed = int(r["reseed"])
+            self._mxu_bf16 = bool(r["mxu_bf16"])
+        else:
+            self._mxu = False
+            self._mxu_reseed = autotune.GRID_MXU_RESEED_DEFAULT
+            self._mxu_bf16 = False
+        if self._fastpath:
+            kernel = "grid_mxu" if self._mxu else "grid"
+        else:
+            kernel = "general"
         self._blocks = autotune.resolve_blocks(
             kernel, len(self.times), min(len(self.freqs), self.chunk_trials),
             poly=self.poly,
@@ -121,6 +140,8 @@ class ResumableScan:
             "poly_trig": bool(self.poly),
             "grid_fastpath": bool(self._fastpath),
             "grid_blocks": list(self._blocks),
+            "grid_mxu": [int(self._mxu), self._mxu_reseed,
+                         int(self._mxu_bf16)],
         }
         self._times_dev = None  # lazy device-resident copy of the events
         self.store = pathlib.Path(store) if store is not None else None
@@ -156,6 +177,18 @@ class ResumableScan:
                     isinstance(store_blocks, list) and len(store_blocks) == 2
                     and all(isinstance(b, int) and b > 0 for b in store_blocks)
                 )
+                # Stores written before the factorized kernel landed carry
+                # no grid_mxu pin; they were computed with it off, so the
+                # adoptable default is exactly that.
+                from crimp_tpu.ops import autotune
+
+                store_mxu = mode.get(
+                    "grid_mxu", [0, autotune.GRID_MXU_RESEED_DEFAULT, 0])
+                mxu_ok = (
+                    isinstance(store_mxu, list) and len(store_mxu) == 3
+                    and store_mxu[0] in (0, 1) and store_mxu[2] in (0, 1)
+                    and isinstance(store_mxu[1], int) and store_mxu[1] > 0
+                )
                 adoptable = (
                     {k: v for k, v in existing.items() if k != "numeric_mode"}
                     == {k: v for k, v in fp.items() if k != "numeric_mode"}
@@ -172,6 +205,12 @@ class ResumableScan:
                              and bool(mode.get("poly_trig")) != self.poly)
                     and not (self._blocks_explicit
                              and store_blocks != list(self._blocks))
+                    and mxu_ok
+                    # same rule for an explicit CRIMP_TPU_GRID_MXU: a run
+                    # pinned to the factorized (or exact) path must not
+                    # silently inherit the other mode's chunks
+                    and not (self._mxu_explicit
+                             and bool(store_mxu[0]) != self._mxu)
                 )
                 if not adoptable:
                     raise ValueError(
@@ -190,6 +229,9 @@ class ResumableScan:
                 self.poly = bool(mode["poly_trig"])
                 self._fastpath = bool(mode["grid_fastpath"])
                 self._blocks = (int(store_blocks[0]), int(store_blocks[1]))
+                self._mxu = bool(store_mxu[0])
+                self._mxu_reseed = int(store_mxu[1])
+                self._mxu_bf16 = bool(store_mxu[2])
                 self._numeric_mode = mode
         else:
             self.store.mkdir(parents=True, exist_ok=True)
@@ -257,6 +299,8 @@ class ResumableScan:
         chunk = self.freqs[lo:lo + self.chunk_trials]
         poly = self.poly
         eb, tb = self._blocks
+        # the PINNED factorized-kernel mode (part of the store fingerprint)
+        mx, rs, b16 = self._mxu, self._mxu_reseed, self._mxu_bf16
         mesh = self._mesh(len(chunk))
         if mesh is not None:
             from crimp_tpu.parallel import mesh as pmesh
@@ -266,11 +310,15 @@ class ResumableScan:
             if self.statistic == "h":
                 rows = pmesh.h_sharded(self.times, chunk, self.nharm,
                                        mesh=mesh, poly=poly,
-                                       use_fastpath=self._fastpath)[None, :]
+                                       use_fastpath=self._fastpath,
+                                       use_mxu=mx, reseed=rs,
+                                       mxu_bf16=b16)[None, :]
             else:
                 rows = pmesh.z2_2d_sharded(self.times, chunk, self.fdots,
                                            self.nharm, mesh=mesh, poly=poly,
-                                           use_fastpath=self._fastpath)
+                                           use_fastpath=self._fastpath,
+                                           use_mxu=mx, reseed=rs,
+                                           mxu_bf16=b16)
             return rows
         grid = search.uniform_grid(self.freqs)  # chunk grids inherit df
         stream = self._stream()
@@ -279,11 +327,13 @@ class ResumableScan:
                 rows = search.h_power_grid_streamed(
                     self.times, float(chunk[0]), grid[1], len(chunk),
                     self.nharm, event_block=eb, trial_block=tb, poly=poly,
+                    mxu=mx, reseed=rs, mxu_bf16=b16,
                 )[None, :]
             elif self._fastpath:
                 rows = search.h_power_grid(
                     self._times_device(), float(chunk[0]), grid[1], len(chunk),
                     self.nharm, event_block=eb, trial_block=tb, poly=poly,
+                    mxu=mx, reseed=rs, mxu_bf16=b16,
                 )[None, :]
             else:
                 rows = search.h_power(
@@ -294,13 +344,13 @@ class ResumableScan:
             rows = search.z2_power_2d_grid_streamed(
                 self.times, float(chunk[0]), grid[1], len(chunk),
                 self.fdots, self.nharm, event_block=eb, trial_block=tb,
-                poly=poly,
+                poly=poly, mxu=mx, reseed=rs, mxu_bf16=b16,
             )
         elif self._fastpath:
             rows = search.z2_power_2d_grid(
                 self._times_device(), float(chunk[0]), grid[1], len(chunk),
                 jnp.asarray(self.fdots), self.nharm, event_block=eb,
-                trial_block=tb, poly=poly,
+                trial_block=tb, poly=poly, mxu=mx, reseed=rs, mxu_bf16=b16,
             )
         else:
             rows = search.z2_power_2d(

@@ -75,9 +75,13 @@ def _env_blocks(default_event: int, default_trial: int) -> tuple[int, int]:
     return eb, tb
 
 
-# Grid fast path: measured optimum on TPU v5e (34.6k vs 33.1k trials/s for
-# the general defaults; see docs/performance.md). Overridable via
-# CRIMP_TPU_GRID_BLOCKS while the post-poly-trig sweep is pending.
+# Grid fast path: static fallback blocking (2^15 events x 512 trials, the
+# pre-poly-trig TPU v5e optimum — 34.6k vs 33.1k trials/s against the
+# general defaults; docs/performance.md). Since the autotuner landed these
+# are only the last-resort defaults: resolve_blocks() prefers a cached
+# sweep winner (scripts/sweep_blocks.py persists per-device/per-trig-path
+# entries, including the factorized "grid_mxu" family), and
+# CRIMP_TPU_GRID_BLOCKS stays the hard override.
 GRID_EVENT_BLOCK, GRID_TRIAL_BLOCK = _env_blocks(1 << 15, 512)
 # The fast path's f32 inner sweep carries phase error up to
 # trial_block/2 * 2^-24 ~ 1.5e-5 cycles, which the Chebyshev recurrence
@@ -382,6 +386,28 @@ def harmonic_sums_uniform(
     return c_all, s_all
 
 
+def _grid_sums_dispatch(times, f0, df, n_freq, nharm, poly,
+                        event_block, trial_block,
+                        mxu, reseed, mxu_bf16):
+    """(c, s, n_events) for the 1-D grid wrappers: resolves the factorized
+    knob (explicit > env > cached winner > off) then the block tiling for
+    whichever kernel won, and dispatches exact vs factorized."""
+    n = np.shape(times)[0]
+    use_mxu, rs, b16 = _resolve_grid_mxu(n, n_freq, poly, mxu, reseed, mxu_bf16)
+    eb, tb = resolve_blocks("grid_mxu" if use_mxu else "grid", n, n_freq,
+                            poly, event_block, trial_block)
+    if use_mxu:
+        c, s = harmonic_sums_uniform_mxu(
+            jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
+            reseed=rs, mxu_bf16=b16,
+        )
+    else:
+        c, s = harmonic_sums_uniform(
+            jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
+        )
+    return c, s, n
+
+
 def z2_power_grid(
     times,
     f0: float,
@@ -391,18 +417,21 @@ def z2_power_grid(
     event_block: int | None = None,
     trial_block: int | None = None,
     poly: bool = False,
+    mxu: bool | None = None,
+    reseed: int | None = None,
+    mxu_bf16: bool | None = None,
 ) -> jax.Array:
     """Z^2_n over the uniform grid f0 + j*df (fast path; see above).
 
     Blocks default to the autotuner resolution (resolve_blocks): explicit
     arguments and CRIMP_TPU_GRID_BLOCKS stay hard overrides, a cached
-    tuner winner is used when present, static defaults otherwise.
+    tuner winner is used when present, static defaults otherwise. ``mxu``
+    selects the factorized matmul kernel the same way (explicit >
+    CRIMP_TPU_GRID_MXU > cached A/B winner > off).
     """
-    n = np.shape(times)[0]
-    eb, tb = resolve_blocks("grid", n, n_freq, poly, event_block, trial_block)
-    c, s = harmonic_sums_uniform(
-        jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
-    )
+    c, s, n = _grid_sums_dispatch(times, f0, df, n_freq, nharm, poly,
+                                  event_block, trial_block, mxu, reseed,
+                                  mxu_bf16)
     return jnp.sum(z2_from_sums(c, s, n), axis=0)
 
 
@@ -415,13 +444,14 @@ def h_power_grid(
     event_block: int | None = None,
     trial_block: int | None = None,
     poly: bool = False,
+    mxu: bool | None = None,
+    reseed: int | None = None,
+    mxu_bf16: bool | None = None,
 ) -> jax.Array:
     """H-test over the uniform grid f0 + j*df (fast path)."""
-    n = np.shape(times)[0]
-    eb, tb = resolve_blocks("grid", n, n_freq, poly, event_block, trial_block)
-    c, s = harmonic_sums_uniform(
-        jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
-    )
+    c, s, n = _grid_sums_dispatch(times, f0, df, n_freq, nharm, poly,
+                                  event_block, trial_block, mxu, reseed,
+                                  mxu_bf16)
     z2_cum = jnp.cumsum(z2_from_sums(c, s, n), axis=0)
     penalties = 4.0 * jnp.arange(nharm, dtype=jnp.float64)[:, None]
     return jnp.max(z2_cum - penalties, axis=0)
@@ -509,6 +539,9 @@ def z2_power_2d_grid(
     event_block: int | None = None,
     trial_block: int | None = None,
     poly: bool = False,
+    mxu: bool | None = None,
+    reseed: int | None = None,
+    mxu_bf16: bool | None = None,
 ) -> jax.Array:
     """Z^2_n over the (fdot x uniform-frequency) grid -> (n_fdot, n_freq).
 
@@ -516,17 +549,305 @@ def z2_power_2d_grid(
     shared across fdots and the per-fdot f64 quadratic rows are shared
     across tiles. ``fdots`` are SIGNED Hz/s as in z2_power_2d. A plain
     (non-jitted) wrapper so blocks resolve through the autotuner per call;
-    the heavy kernel underneath stays jitted.
+    the heavy kernel underneath stays jitted. ``mxu`` selects the
+    factorized matmul kernel (explicit > CRIMP_TPU_GRID_MXU > cached A/B
+    winner > off).
     """
     times = jnp.asarray(times)
     n = times.shape[0]
-    eb, tb = resolve_blocks("grid", int(n), int(n_freq), poly,
-                            event_block, trial_block)
-    c, s = harmonic_sums_uniform_2d(
-        times, f0, df, n_freq, jnp.asarray(fdots, dtype=jnp.float64), nharm,
-        eb, tb, poly=poly,
-    )
+    use_mxu, rs, b16 = _resolve_grid_mxu(int(n), int(n_freq), poly, mxu,
+                                         reseed, mxu_bf16)
+    eb, tb = resolve_blocks("grid_mxu" if use_mxu else "grid", int(n),
+                            int(n_freq), poly, event_block, trial_block)
+    fd = jnp.asarray(fdots, dtype=jnp.float64)
+    if use_mxu:
+        c, s = harmonic_sums_uniform_2d_mxu(
+            times, f0, df, n_freq, fd, nharm, eb, tb, poly=poly,
+            reseed=rs, mxu_bf16=b16,
+        )
+    else:
+        c, s = harmonic_sums_uniform_2d(
+            times, f0, df, n_freq, fd, nharm, eb, tb, poly=poly,
+        )
     return jnp.sum(z2_from_sums(c, s, n), axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Factorized (matmul) uniform-grid kernels — the CRIMP_TPU_GRID_MXU path
+# ---------------------------------------------------------------------------
+#
+# The uniform-grid phase is AFFINE in the trial index: for trial
+# j = j0 + j_lo, harmonic k and fdot row i,
+#
+#     k*phase(j, i, e) = k*theta0(tile, i, e) + j_lo * (k * b_e)
+#
+# so cos/sin factor by the angle-addition identity into a per-ROW part
+# (theta0: one row per tile [+ fdot], Chebyshev in k — shared across the
+# whole j_lo sweep) and a per-TRIAL sweep part (cos/sin(2*pi*j_lo*k*b_e):
+# rotation recurrence in j_lo + Chebyshev in k — shared across ALL tiles
+# and fdot rows). The harmonic sums then become real matmuls
+#
+#     C_k = Xw_k @ Csw_k^T - Yw_k @ Ssw_k^T        (n_rows, EB) @ (EB, TB)
+#     S_k = Yw_k @ Csw_k^T + Xw_k @ Ssw_k^T
+#
+# with Xw_k[r, e] = w_e*cos(2*pi*k*theta0), Yw_k the sine twin. Per-block
+# transcendentals drop from O(n_rows*TB*EB) (dense: one sin/cos pair per
+# (trial, event) pair) to O((n_rows + TB/reseed + 1)*EB), and the event
+# reduction moves from VPU tree-sums onto the MXU. The 2-D kernel benefits
+# most: its rows stack as n_fdot*n_tiles. Exact kernels above stay the
+# default and the fallback, untouched at the bit level.
+#
+# Error budget (docs/performance.md "Factorized grid kernels" derives and
+# tests/test_search.py::TestGridMXU pins it): the sweep seeds carry the
+# same trial_block/2 * 2^-24 ~ 1.5e-5-cycle f32 bound as the exact fast
+# path's j_lo*b product; the angle-addition rotation adds a random-walk
+# drift of ~sqrt(reseed)*2^-24 per trig value between exact-sincos
+# reseeds, so the default reseed=64 keeps the drift (~1e-6) at the
+# poly-trig floor (3.1e-7..3.6e-7 per value) rather than above it.
+
+GRID_MXU_RESEED = 64  # default reseed stride (autotunable; power of two
+# keeps the seed product reseed*b exact in f32)
+
+
+def _trig_rows(frac_cycles: jax.Array, poly: bool):
+    """(cos, sin) of 2*pi*frac_cycles, input already reduced to [-0.5, 0.5)."""
+    if poly:
+        s, c = fasttrig.sincos_cycles(frac_cycles)
+        return c, s
+    theta = (2 * np.pi) * frac_cycles
+    return jnp.cos(theta), jnp.sin(theta)
+
+
+def _sweep_matrices(b_blk: jax.Array, trial_block: int, reseed: int, poly: bool):
+    """cos/sin(2*pi*j_lo*b_e) for j_lo = 0..trial_block-1 -> (TB, EB) pair.
+
+    Exact sincos is evaluated only at the reseed anchors j_lo = m*reseed
+    (ceil(TB/reseed) rows); within a segment the pair advances by the
+    angle-addition rotation cos((j+1)a) = cos(ja)cos(a) - sin(ja)sin(a),
+    which is exact in infinite precision — its f32 drift random-walks at
+    ~2^-24 per step and is cut back to zero at every anchor. The anchor
+    phases m*reseed*b are reduced in f32, the same trial_block/2 * 2^-24
+    bound the exact kernel's j_lo*b product carries.
+    """
+    reseed = max(1, min(int(reseed), trial_block))
+    n_seg = -(-trial_block // reseed)
+    seg = jnp.arange(n_seg, dtype=jnp.float32)
+    seed_frac = fasttrig.centered_frac(seg[:, None] * (reseed * b_blk)[None, :])
+    c_seed, s_seed = _trig_rows(seed_frac, poly)   # (n_seg, EB)
+    ca, sa = _trig_rows(b_blk, poly)               # rotation by 2*pi*b
+
+    def rot(carry, _):
+        c, s = carry
+        return (c * ca - s * sa, s * ca + c * sa), (c, s)
+
+    _, (c_all, s_all) = jax.lax.scan(rot, (c_seed, s_seed), None, length=reseed)
+    csw = jnp.moveaxis(c_all, 0, 1).reshape(n_seg * reseed, -1)[:trial_block]
+    ssw = jnp.moveaxis(s_all, 0, 1).reshape(n_seg * reseed, -1)[:trial_block]
+    return csw, ssw
+
+
+def _mxu_dot(a: jax.Array, b: jax.Array, mxu_bf16: bool) -> jax.Array:
+    """a @ b^T with f32 accumulation; optional bf16 operands (MXU native)."""
+    if mxu_bf16:
+        a = a.astype(jnp.bfloat16)
+        b = b.astype(jnp.bfloat16)
+    return jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+
+
+def _factored_harmonic_sums(cos0, sin0, weights, csw, ssw, nharm: int,
+                            mxu_bf16: bool):
+    """(C, S) of shape (nharm, n_rows, TB) from the factor matrices.
+
+    ``cos0``/``sin0``: (n_rows, EB) trig of the per-row base phase theta0;
+    ``csw``/``ssw``: (TB, EB) sweep matrices cos/sin(2*pi*j_lo*b). Harmonic
+    k of BOTH factors comes from the same Chebyshev recurrence the dense
+    kernel uses; the event reduction runs as four f32-accumulated matmuls
+    per harmonic — MXU work instead of VPU transcendentals.
+    """
+    w = weights[None, :]
+    ck, sk = cos0, sin0
+    ck_m2 = jnp.ones_like(cos0)
+    sk_m2 = jnp.zeros_like(sin0)
+    cswk, sswk = csw, ssw
+    cswk_m2 = jnp.ones_like(csw)
+    sswk_m2 = jnp.zeros_like(ssw)
+    c_list, s_list = [], []
+    for k in range(nharm):
+        if k:
+            ck, ck_m2 = 2 * cos0 * ck - ck_m2, ck
+            sk, sk_m2 = 2 * cos0 * sk - sk_m2, sk
+            cswk, cswk_m2 = 2 * csw * cswk - cswk_m2, cswk
+            sswk, sswk_m2 = 2 * csw * sswk - sswk_m2, sswk
+        xw = w * ck
+        yw = w * sk
+        c_list.append(_mxu_dot(xw, cswk, mxu_bf16) - _mxu_dot(yw, sswk, mxu_bf16))
+        s_list.append(_mxu_dot(yw, cswk, mxu_bf16) + _mxu_dot(xw, sswk, mxu_bf16))
+    return (jnp.stack(c_list).astype(jnp.float64),
+            jnp.stack(s_list).astype(jnp.float64))
+
+
+def _mxu_1d_step(f_tiles, fdot, nharm, trial_block, poly, reseed, mxu_bf16):
+    """Per-event-block scan body of the factorized 1-D kernel — shared by
+    the monolithic kernel and the streamed carry update so the streamed
+    result stays bit-identical to the monolithic one."""
+
+    def step(carry, blk):
+        t_blk, w_blk, b_blk = blk
+        theta0 = fasttrig.centered_frac(
+            f_tiles[:, None] * t_blk[None, :]
+            + ((0.5 * fdot) * t_blk**2)[None, :]
+        ).astype(jnp.float32)                          # (n_tiles, EB)
+        c0, s0 = _trig_rows(theta0, poly)
+        csw, ssw = _sweep_matrices(b_blk, trial_block, reseed, poly)
+        ck, sk = _factored_harmonic_sums(
+            c0, s0, w_blk.astype(jnp.float32), csw, ssw, nharm, mxu_bf16)
+        return (carry[0] + ck, carry[1] + sk), None
+
+    return step
+
+
+def _mxu_2d_step(f_tiles, fd, nharm, n_tiles, trial_block, poly, reseed,
+                 mxu_bf16):
+    """Per-event-block scan body of the factorized 2-D kernel (shared by
+    the monolithic kernel and the streamed carry update)."""
+    n_fdot = fd.shape[0]
+
+    def step(carry, blk):
+        t_blk, w_blk, b_blk = blk
+        row_t = fasttrig.centered_frac(
+            f_tiles[:, None] * t_blk[None, :]).astype(jnp.float32)
+        row_q = fasttrig.centered_frac(
+            (0.5 * fd)[:, None] * (t_blk * t_blk)[None, :]).astype(jnp.float32)
+        ct, st = _trig_rows(row_t, poly)               # (n_tiles, EB)
+        cq, sq = _trig_rows(row_q, poly)               # (n_fdot, EB)
+        # cos/sin(2*pi*(theta_tile + theta_fdot)) by angle addition: one
+        # elementwise outer combine instead of n_fdot*n_tiles transcendental
+        # rows — the rows then stack as the matmul's M axis
+        c0 = (cq[:, None, :] * ct[None, :, :]
+              - sq[:, None, :] * st[None, :, :]).reshape(n_fdot * n_tiles, -1)
+        s0 = (sq[:, None, :] * ct[None, :, :]
+              + cq[:, None, :] * st[None, :, :]).reshape(n_fdot * n_tiles, -1)
+        csw, ssw = _sweep_matrices(b_blk, trial_block, reseed, poly)
+        ck, sk = _factored_harmonic_sums(
+            c0, s0, w_blk.astype(jnp.float32), csw, ssw, nharm, mxu_bf16)
+        ck = ck.reshape(nharm, n_fdot, n_tiles, trial_block)
+        sk = sk.reshape(nharm, n_fdot, n_tiles, trial_block)
+        return (carry[0] + ck, carry[1] + sk), None
+
+    return step
+
+
+@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block",
+                                   "trial_block", "poly", "reseed", "mxu_bf16"))
+def harmonic_sums_uniform_mxu(
+    times: jax.Array,
+    f0: float,
+    df: float,
+    n_freq: int,
+    nharm: int,
+    event_block: int = GRID_EVENT_BLOCK,
+    trial_block: int = GRID_TRIAL_BLOCK,
+    fdot: float | jax.Array = 0.0,
+    weights: jax.Array | None = None,
+    poly: bool = False,
+    reseed: int = GRID_MXU_RESEED,
+    mxu_bf16: bool = False,
+):
+    """Factorized (matmul) twin of :func:`harmonic_sums_uniform`.
+
+    Same contract and output shape (nharm, n_freq); the harmonic sums are
+    computed as C_k = Xw_k @ Csw_k^T - Yw_k @ Ssw_k^T per event block (see
+    the section comment above for the factorization and its error budget).
+    Cross-block accumulation stays f64 in the same scan order as the exact
+    kernel.
+    """
+    time_blocks, weight_blocks = _block_times(times, event_block, weights)
+    n_tiles = -(-n_freq // trial_block)
+    b_blocks = fasttrig.centered_frac(df * time_blocks).astype(jnp.float32)
+    f_tiles = f0 + (jnp.arange(n_tiles, dtype=jnp.float64) * trial_block) * df
+    anchor = 0.0 * (time_blocks[0, 0] + f_tiles[0])
+    zeros = jnp.zeros((nharm, n_tiles, trial_block), jnp.float64) + anchor
+    step = _mxu_1d_step(f_tiles, fdot, nharm, trial_block, poly, reseed,
+                        mxu_bf16)
+    (c_sum, s_sum), _ = jax.lax.scan(
+        step, (zeros, zeros), (time_blocks, weight_blocks, b_blocks))
+    c_all = c_sum.reshape(nharm, -1)[:, :n_freq]
+    s_all = s_sum.reshape(nharm, -1)[:, :n_freq]
+    return c_all, s_all
+
+
+@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block",
+                                   "trial_block", "poly", "reseed", "mxu_bf16"))
+def harmonic_sums_uniform_2d_mxu(
+    times: jax.Array,
+    f0: float,
+    df: float,
+    n_freq: int,
+    fdots: jax.Array,
+    nharm: int,
+    event_block: int = GRID_EVENT_BLOCK,
+    trial_block: int = GRID_TRIAL_BLOCK,
+    weights: jax.Array | None = None,
+    poly: bool = False,
+    reseed: int = GRID_MXU_RESEED,
+    mxu_bf16: bool = False,
+    tile0: int | jax.Array = 0,
+):
+    """Factorized (matmul) twin of :func:`harmonic_sums_uniform_2d`.
+
+    Same contract and output shapes (n_fdot, nharm, n_freq). This is the
+    kernel the factorization helps most: the dense path pays one sin/cos
+    pair per (fdot, tile, trial, event) while here the transcendental count
+    is O((n_tiles + n_fdot + TB/reseed)*EB) per block and the reduction is
+    n_fdot*n_tiles matmul rows — deep MXU work.
+
+    ``tile0`` offsets the tile index (traced; the sharded wrapper passes
+    the shard's global first tile so f_tiles rounds in ONE f64 multiply,
+    bitwise-identical to the monolithic kernel's expression — adding a
+    pre-rounded f0_shard instead would cost a second rounding).
+    """
+    time_blocks, weight_blocks = _block_times(times, event_block, weights)
+    n_tiles = -(-n_freq // trial_block)
+    b_blocks = fasttrig.centered_frac(df * time_blocks).astype(jnp.float32)
+    tiles = (jnp.asarray(tile0, jnp.float64)
+             + jnp.arange(n_tiles, dtype=jnp.float64))
+    f_tiles = f0 + (tiles * trial_block) * df
+    fd = jnp.asarray(fdots, dtype=jnp.float64)
+    n_fdot = fd.shape[0]
+    if n_fdot == 0:  # static at trace time; empty grid -> empty result
+        empty = jnp.zeros((0, nharm, n_freq), jnp.float64)
+        return empty, empty
+    anchor = 0.0 * (time_blocks[0, 0] + f_tiles[0] + jnp.sum(fd))
+    zeros = jnp.zeros((nharm, n_fdot, n_tiles, trial_block), jnp.float64) + anchor
+    step = _mxu_2d_step(f_tiles, fd, nharm, n_tiles, trial_block, poly,
+                        reseed, mxu_bf16)
+    (c_sum, s_sum), _ = jax.lax.scan(
+        step, (zeros, zeros), (time_blocks, weight_blocks, b_blocks))
+    c_all = jnp.moveaxis(c_sum, 0, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
+    s_all = jnp.moveaxis(s_sum, 0, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
+    return c_all, s_all
+
+
+def _resolve_grid_mxu(n_events: int, n_trials: int, poly: bool,
+                      mxu: bool | None, reseed: int | None,
+                      mxu_bf16: bool | None) -> tuple[bool, int, bool]:
+    """(use_mxu, reseed, mxu_bf16) for the grid wrappers.
+
+    Explicit arguments are hard overrides; anything left None resolves
+    through autotune.resolve_grid_mxu (env CRIMP_TPU_GRID_MXU > cached A/B
+    winner > default off — same precedence discipline as the ToA knobs).
+    """
+    if mxu is not None and reseed is not None and mxu_bf16 is not None:
+        return bool(mxu), int(reseed), bool(mxu_bf16)
+    from crimp_tpu.ops import autotune
+
+    r = autotune.resolve_grid_mxu(n_events, n_trials, poly=poly)
+    use = bool(r["grid_mxu"]) if mxu is None else bool(mxu)
+    rs = int(r["reseed"]) if reseed is None else int(reseed)
+    b16 = bool(r["mxu_bf16"]) if mxu_bf16 is None else bool(mxu_bf16)
+    return use, rs, b16
 
 
 # ---------------------------------------------------------------------------
@@ -649,6 +970,54 @@ def _grid2d_stream_update(nharm: int, n_tiles: int, event_block: int,
     return jax.jit(update, donate_argnums=(0, 1) if donate else ())
 
 
+@lru_cache(maxsize=None)
+def _grid_stream_update_mxu(nharm: int, n_tiles: int, event_block: int,
+                            trial_block: int, poly: bool, reseed: int,
+                            mxu_bf16: bool, donate: bool):
+    """Jitted carry update for one streamed chunk of the factorized 1-D
+    kernel. The body is the SAME _mxu_1d_step the monolithic kernel scans
+    with (same per-block matmuls, same f64 accumulation order), so the
+    streamed result is bit-identical to the monolithic one."""
+
+    def update(c, s, chunk_times, n_valid, f0, df, fdot):
+        time_blocks = chunk_times.reshape(-1, event_block)
+        w = (jnp.arange(chunk_times.shape[0]) < n_valid).astype(jnp.float64)
+        weight_blocks = w.reshape(-1, event_block)
+        b_blocks = fasttrig.centered_frac(df * time_blocks).astype(jnp.float32)
+        f_tiles = f0 + (jnp.arange(n_tiles, dtype=jnp.float64) * trial_block) * df
+        step = _mxu_1d_step(f_tiles, fdot, nharm, trial_block, poly, reseed,
+                            mxu_bf16)
+        (c1, s1), _ = jax.lax.scan(
+            step, (c, s), (time_blocks, weight_blocks, b_blocks))
+        return c1, s1
+
+    return jax.jit(update, donate_argnums=(0, 1) if donate else ())
+
+
+@lru_cache(maxsize=None)
+def _grid2d_stream_update_mxu(nharm: int, n_tiles: int, event_block: int,
+                              trial_block: int, poly: bool, reseed: int,
+                              mxu_bf16: bool, donate: bool):
+    """Jitted carry update for one streamed chunk of the factorized 2-D
+    kernel (same replay-the-monolithic-scan-body contract as
+    _grid_stream_update_mxu)."""
+
+    def update(c, s, chunk_times, n_valid, f0, df, fdots):
+        time_blocks = chunk_times.reshape(-1, event_block)
+        w = (jnp.arange(chunk_times.shape[0]) < n_valid).astype(jnp.float64)
+        weight_blocks = w.reshape(-1, event_block)
+        b_blocks = fasttrig.centered_frac(df * time_blocks).astype(jnp.float32)
+        f_tiles = f0 + (jnp.arange(n_tiles, dtype=jnp.float64) * trial_block) * df
+        fd = jnp.asarray(fdots, dtype=jnp.float64)
+        step = _mxu_2d_step(f_tiles, fd, nharm, n_tiles, trial_block, poly,
+                            reseed, mxu_bf16)
+        (c1, s1), _ = jax.lax.scan(
+            step, (c, s), (time_blocks, weight_blocks, b_blocks))
+        return c1, s1
+
+    return jax.jit(update, donate_argnums=(0, 1) if donate else ())
+
+
 def _stream_chunks(times: np.ndarray, event_block: int, event_chunk: int):
     """Host-side chunk plan: [(padded_chunk, n_valid), ...].
 
@@ -680,14 +1049,17 @@ def _stream_chunks(times: np.ndarray, event_block: int, event_chunk: int):
 
 
 def _streamed_uniform_sums(times, f0, df, n_freq, nharm, event_block,
-                           trial_block, poly, fdots=None, event_chunk=None):
+                           trial_block, poly, fdots=None, event_chunk=None,
+                           mxu: bool = False, reseed: int = GRID_MXU_RESEED,
+                           mxu_bf16: bool = False):
     """Double-buffered driver shared by the streamed grid kernels.
 
     The host->device transfer of chunk i+1 is issued (async device_put)
     BEFORE the carry update of chunk i is dispatched, so on accelerators
     the copy runs under the compute and the per-chunk host sync of the
     naive loop disappears. Returns the same (c, s) sums as the monolithic
-    harmonic_sums_uniform / _2d calls, bit-for-bit.
+    harmonic_sums_uniform / _2d calls, bit-for-bit (``mxu=True`` streams
+    the factorized kernels under the identical contract).
     """
     times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
     n_tiles = -(-n_freq // trial_block)
@@ -700,23 +1072,45 @@ def _streamed_uniform_sums(times, f0, df, n_freq, nharm, event_block,
         # carry update's scan could not replay the monolithic loop form)
         dev_times = jnp.asarray(times)
         if fdots is None:
+            if mxu:
+                return harmonic_sums_uniform_mxu(
+                    dev_times, f0, df, n_freq, nharm, event_block,
+                    trial_block, poly=poly, reseed=reseed, mxu_bf16=mxu_bf16)
             return harmonic_sums_uniform(
                 dev_times, f0, df, n_freq, nharm, event_block, trial_block,
                 poly=poly)
+        fd = jnp.asarray(fdots, dtype=jnp.float64)
+        if mxu:
+            return harmonic_sums_uniform_2d_mxu(
+                dev_times, f0, df, n_freq, fd, nharm, event_block,
+                trial_block, poly=poly, reseed=reseed, mxu_bf16=mxu_bf16)
         return harmonic_sums_uniform_2d(
-            dev_times, f0, df, n_freq, jnp.asarray(fdots, dtype=jnp.float64),
+            dev_times, f0, df, n_freq, fd,
             nharm, event_block, trial_block, poly=poly)
     donate = jax.default_backend() != "cpu"
     if fdots is None:
-        update = _grid_stream_update(nharm, n_tiles, event_block,
-                                     trial_block, poly, donate)
-        carry_shape = (n_tiles, nharm, trial_block)
+        if mxu:
+            update = _grid_stream_update_mxu(nharm, n_tiles, event_block,
+                                             trial_block, poly, reseed,
+                                             mxu_bf16, donate)
+            carry_shape = (nharm, n_tiles, trial_block)
+        else:
+            update = _grid_stream_update(nharm, n_tiles, event_block,
+                                         trial_block, poly, donate)
+            carry_shape = (n_tiles, nharm, trial_block)
         extra = (0.0,)
     else:
         fdots = jnp.asarray(fdots, dtype=jnp.float64)
-        update = _grid2d_stream_update(nharm, n_tiles, event_block,
-                                       trial_block, poly, donate)
-        carry_shape = (int(fdots.shape[0]), n_tiles, nharm, trial_block)
+        n_fdot = int(fdots.shape[0])
+        if mxu:
+            update = _grid2d_stream_update_mxu(nharm, n_tiles, event_block,
+                                               trial_block, poly, reseed,
+                                               mxu_bf16, donate)
+            carry_shape = (nharm, n_fdot, n_tiles, trial_block)
+        else:
+            update = _grid2d_stream_update(nharm, n_tiles, event_block,
+                                           trial_block, poly, donate)
+            carry_shape = (n_fdot, n_tiles, nharm, trial_block)
         extra = (fdots,)
     c = jnp.zeros(carry_shape, dtype=jnp.float64)
     s = jnp.zeros(carry_shape, dtype=jnp.float64)
@@ -726,10 +1120,16 @@ def _streamed_uniform_sums(times, f0, df, n_freq, nharm, event_block,
         c, s = update(c, s, dev, n_valid, f0, df, *extra)
         dev = nxt
     if fdots is None:
-        c_all = jnp.moveaxis(c, 1, 0).reshape(nharm, -1)[:, :n_freq]
-        s_all = jnp.moveaxis(s, 1, 0).reshape(nharm, -1)[:, :n_freq]
+        if mxu:
+            c_all = c.reshape(nharm, -1)[:, :n_freq]
+            s_all = s.reshape(nharm, -1)[:, :n_freq]
+        else:
+            c_all = jnp.moveaxis(c, 1, 0).reshape(nharm, -1)[:, :n_freq]
+            s_all = jnp.moveaxis(s, 1, 0).reshape(nharm, -1)[:, :n_freq]
+    elif mxu:
+        c_all = jnp.moveaxis(c, 0, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
+        s_all = jnp.moveaxis(s, 0, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
     else:
-        n_fdot = carry_shape[0]
         c_all = jnp.moveaxis(c, 2, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
         s_all = jnp.moveaxis(s, 2, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
     return c_all, s_all
@@ -739,12 +1139,17 @@ def z2_power_grid_streamed(
     times, f0: float, df: float, n_freq: int, nharm: int = 2,
     event_block: int | None = None, trial_block: int | None = None,
     poly: bool = False, event_chunk: int | None = None,
+    mxu: bool | None = None, reseed: int | None = None,
+    mxu_bf16: bool | None = None,
 ) -> jax.Array:
     """z2_power_grid with double-buffered host->device event streaming."""
     n = np.shape(times)[0]
-    eb, tb = resolve_blocks("grid", n, n_freq, poly, event_block, trial_block)
+    use_mxu, rs, b16 = _resolve_grid_mxu(n, n_freq, poly, mxu, reseed, mxu_bf16)
+    eb, tb = resolve_blocks("grid_mxu" if use_mxu else "grid", n, n_freq,
+                            poly, event_block, trial_block)
     c, s = _streamed_uniform_sums(times, f0, df, n_freq, nharm, eb, tb, poly,
-                                  event_chunk=event_chunk)
+                                  event_chunk=event_chunk, mxu=use_mxu,
+                                  reseed=rs, mxu_bf16=b16)
     return jnp.sum(z2_from_sums(c, s, n), axis=0)
 
 
@@ -752,12 +1157,17 @@ def h_power_grid_streamed(
     times, f0: float, df: float, n_freq: int, nharm: int = 20,
     event_block: int | None = None, trial_block: int | None = None,
     poly: bool = False, event_chunk: int | None = None,
+    mxu: bool | None = None, reseed: int | None = None,
+    mxu_bf16: bool | None = None,
 ) -> jax.Array:
     """h_power_grid with double-buffered host->device event streaming."""
     n = np.shape(times)[0]
-    eb, tb = resolve_blocks("grid", n, n_freq, poly, event_block, trial_block)
+    use_mxu, rs, b16 = _resolve_grid_mxu(n, n_freq, poly, mxu, reseed, mxu_bf16)
+    eb, tb = resolve_blocks("grid_mxu" if use_mxu else "grid", n, n_freq,
+                            poly, event_block, trial_block)
     c, s = _streamed_uniform_sums(times, f0, df, n_freq, nharm, eb, tb, poly,
-                                  event_chunk=event_chunk)
+                                  event_chunk=event_chunk, mxu=use_mxu,
+                                  reseed=rs, mxu_bf16=b16)
     z2_cum = jnp.cumsum(z2_from_sums(c, s, n), axis=0)
     penalties = 4.0 * jnp.arange(nharm, dtype=jnp.float64)[:, None]
     return jnp.max(z2_cum - penalties, axis=0)
@@ -767,12 +1177,17 @@ def z2_power_2d_grid_streamed(
     times, f0: float, df: float, n_freq: int, fdots, nharm: int = 2,
     event_block: int | None = None, trial_block: int | None = None,
     poly: bool = False, event_chunk: int | None = None,
+    mxu: bool | None = None, reseed: int | None = None,
+    mxu_bf16: bool | None = None,
 ) -> jax.Array:
     """z2_power_2d_grid with double-buffered host->device event streaming."""
     n = np.shape(times)[0]
-    eb, tb = resolve_blocks("grid", n, n_freq, poly, event_block, trial_block)
+    use_mxu, rs, b16 = _resolve_grid_mxu(n, n_freq, poly, mxu, reseed, mxu_bf16)
+    eb, tb = resolve_blocks("grid_mxu" if use_mxu else "grid", n, n_freq,
+                            poly, event_block, trial_block)
     c, s = _streamed_uniform_sums(times, f0, df, n_freq, nharm, eb, tb, poly,
-                                  fdots=fdots, event_chunk=event_chunk)
+                                  fdots=fdots, event_chunk=event_chunk,
+                                  mxu=use_mxu, reseed=rs, mxu_bf16=b16)
     return jnp.sum(z2_from_sums(c, s, n), axis=1)
 
 

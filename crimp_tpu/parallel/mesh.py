@@ -55,10 +55,13 @@ from crimp_tpu.ops.search import (
     DEFAULT_TRIAL_BLOCK,
     DEFAULT_TRIG_DTYPE,
     GRID_EVENT_BLOCK,
+    GRID_MXU_RESEED,
     GRID_TRIAL_BLOCK,
     _blocked_trial_sums,
+    _resolve_grid_mxu,
     grid_fastpath_enabled,
     harmonic_sums_uniform_2d,
+    harmonic_sums_uniform_2d_mxu,
     resolve_blocks,
     uniform_grid,
     z2_from_sums,
@@ -183,7 +186,8 @@ def _sharded_sums_general(
 
 @partial(
     jax.jit,
-    static_argnames=("n_freq", "nharm", "mesh", "event_block", "trial_block", "poly"),
+    static_argnames=("n_freq", "nharm", "mesh", "event_block", "trial_block",
+                     "poly", "mxu", "reseed", "mxu_bf16"),
 )
 def _sharded_sums_grid(
     times,
@@ -197,12 +201,17 @@ def _sharded_sums_grid(
     event_block: int = GRID_EVENT_BLOCK,
     trial_block: int = GRID_TRIAL_BLOCK,
     poly: bool = False,
+    mxu: bool = False,
+    reseed: int = GRID_MXU_RESEED,
+    mxu_bf16: bool = False,
 ):
     """Uniform-grid fast-path trig sums under sharding.
 
     ``n_freq`` must be a multiple of the trial-mesh size; each trial tile
     owns the contiguous range starting at f0 + tile*n_freq_shard*df, so the
-    per-tile f64-row decomposition of the fast path is preserved.
+    per-tile f64-row decomposition of the fast path is preserved. With
+    ``mxu`` the per-shard kernel is the factorized matmul variant; the f64
+    psum combine is identical either way.
     """
     tr_size = mesh.shape[TRIAL_AXIS]
     n_freq_shard = n_freq // tr_size
@@ -213,10 +222,27 @@ def _sharded_sums_grid(
         # shared-row 2-D kernel: per-tile f64 frequency rows shared across
         # fdots, per-fdot quadratic rows shared across tiles (same win as
         # the single-device path; see harmonic_sums_uniform_2d)
-        c_all, s_all = harmonic_sums_uniform_2d(
-            t_shard, f0_shard, df, n_freq_shard, fd_all, nharm,
-            event_block, trial_block, weights=w_shard, poly=poly,
-        )
+        if mxu and n_freq_shard % trial_block == 0:
+            # pass the GLOBAL f0 plus the shard's first tile index: f_tiles
+            # then rounds in the same single f64 multiply as the monolithic
+            # kernel, keeping the sharded output bitwise-equal to it
+            c_all, s_all = harmonic_sums_uniform_2d_mxu(
+                t_shard, f0, df, n_freq_shard, fd_all, nharm,
+                event_block, trial_block, weights=w_shard, poly=poly,
+                reseed=reseed, mxu_bf16=mxu_bf16,
+                tile0=tile * (n_freq_shard // trial_block),
+            )
+        elif mxu:
+            c_all, s_all = harmonic_sums_uniform_2d_mxu(
+                t_shard, f0_shard, df, n_freq_shard, fd_all, nharm,
+                event_block, trial_block, weights=w_shard, poly=poly,
+                reseed=reseed, mxu_bf16=mxu_bf16,
+            )
+        else:
+            c_all, s_all = harmonic_sums_uniform_2d(
+                t_shard, f0_shard, df, n_freq_shard, fd_all, nharm,
+                event_block, trial_block, weights=w_shard, poly=poly,
+            )
         return jax.lax.psum(c_all, EVENT_AXIS), jax.lax.psum(s_all, EVENT_AXIS)
 
     return shard_map(
@@ -237,7 +263,8 @@ def _fit_block(default: int, per_shard: int) -> int:
 
 
 def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath,
-                     poly: bool = False):
+                     poly: bool = False, use_mxu: bool | None = None,
+                     reseed: int | None = None, mxu_bf16: bool | None = None):
     """(c, s) trig sums of shape (n_fdot, nharm, n_freq) with host-side
     padding to the mesh tiling; dispatches grid fast path vs general."""
     ev_size = mesh.shape[EVENT_AXIS]
@@ -254,15 +281,21 @@ def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath,
     if grid is not None:
         f0, df = grid
         n_freq_pad = -(-n_freq // tr_size) * tr_size
+        # The factorized-kernel knob resolves at shard scale too: the cache
+        # entry that won the A/B at this per-device workload is the one that
+        # transfers.
+        mx, rs, b16 = _resolve_grid_mxu(ev_per_shard, tr_per_shard, poly,
+                                        use_mxu, reseed, mxu_bf16)
         # Per-SHARD workload is what each device tiles, so the autotuner is
         # consulted at shard scale and _fit_block then shrinks the winner
         # to small inputs exactly as it always shrank the static default.
-        g_eb, g_tb = resolve_blocks("grid", ev_per_shard, tr_per_shard, poly)
+        g_eb, g_tb = resolve_blocks("grid_mxu" if mx else "grid",
+                                    ev_per_shard, tr_per_shard, poly)
         c, s = _sharded_sums_grid(
             jnp.asarray(t_pad), jnp.asarray(w_pad), f0, df, n_freq_pad, fd, nharm, mesh,
             event_block=_fit_block(g_eb, ev_per_shard),
             trial_block=_fit_block(g_tb, tr_per_shard),
-            poly=poly,
+            poly=poly, mxu=mx, reseed=rs, mxu_bf16=b16,
         )
     else:
         f_pad, _ = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
@@ -280,22 +313,28 @@ def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath,
 def z2_sharded(
     times, freqs, nharm: int = 2, mesh: Mesh | None = None, trig_dtype=None,
     use_fastpath: bool | None = None, poly: bool = False,
+    use_mxu: bool | None = None, reseed: int | None = None,
+    mxu_bf16: bool | None = None,
 ) -> np.ndarray:
     """Z^2_n over the frequency grid, events sharded across the mesh."""
     if mesh is None:
         mesh = build_mesh()
-    c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype, use_fastpath, poly)
+    c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype,
+                            use_fastpath, poly, use_mxu, reseed, mxu_bf16)
     return np.asarray(jnp.sum(z2_from_sums(c[0], s[0], len(times)), axis=0))
 
 
 def h_sharded(
     times, freqs, nharm: int = 20, mesh: Mesh | None = None, trig_dtype=None,
     use_fastpath: bool | None = None, poly: bool = False,
+    use_mxu: bool | None = None, reseed: int | None = None,
+    mxu_bf16: bool | None = None,
 ) -> np.ndarray:
     """H-test over the frequency grid, events sharded across the mesh."""
     if mesh is None:
         mesh = build_mesh()
-    c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype, use_fastpath, poly)
+    c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype,
+                            use_fastpath, poly, use_mxu, reseed, mxu_bf16)
     z2_cum = jnp.cumsum(z2_from_sums(c[0], s[0], len(times)), axis=0)
     penalties = 4.0 * jnp.arange(nharm)[:, None]
     return np.asarray(jnp.max(z2_cum - penalties, axis=0))
@@ -304,13 +343,16 @@ def h_sharded(
 def z2_2d_sharded(
     times, freqs, fdots, nharm: int = 2, mesh: Mesh | None = None, trig_dtype=None,
     use_fastpath: bool | None = None, poly: bool = False,
+    use_mxu: bool | None = None, reseed: int | None = None,
+    mxu_bf16: bool | None = None,
 ) -> np.ndarray:
     """Z^2_n over the (fdot, freq) grid -> (n_fdot, n_freq), events sharded
     across the mesh with psum combines (fdots replicated; the frequency axis
     shards over the trial mesh axis)."""
     if mesh is None:
         mesh = build_mesh()
-    c, s = _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath, poly)
+    c, s = _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype,
+                            use_fastpath, poly, use_mxu, reseed, mxu_bf16)
     return np.asarray(jnp.sum(z2_from_sums(c, s, len(times)), axis=1))
 
 

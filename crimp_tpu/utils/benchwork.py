@@ -51,8 +51,9 @@ def candidate_rate(kernel: str, sec, freqs, f0, df, n_trials: int,
 
     ``kernel`` selects the variant family being tuned: "grid" times the
     uniform-grid fast path (harmonic_sums_uniform, the same jitted core
-    z2/h _power_grid call), "general" the arbitrary-frequency blockwise
-    kernel. Returns a device-synchronized rate via best_rate.
+    z2/h _power_grid call), "grid_mxu" the factorized matmul variant,
+    "general" the arbitrary-frequency blockwise kernel. Returns a
+    device-synchronized rate via best_rate.
     """
     import jax.numpy as jnp
 
@@ -63,6 +64,10 @@ def candidate_rate(kernel: str, sec, freqs, f0, df, n_trials: int,
     # value, so hand it one array (syncing either syncs the whole computation)
     if kernel == "grid":
         fn = lambda: search.harmonic_sums_uniform(  # noqa: E731
+            times, float(f0), float(df), int(n_trials), nharm,
+            event_block=event_block, trial_block=trial_block, poly=poly)[0]
+    elif kernel == "grid_mxu":
+        fn = lambda: search.harmonic_sums_uniform_mxu(  # noqa: E731
             times, float(f0), float(df), int(n_trials), nharm,
             event_block=event_block, trial_block=trial_block, poly=poly)[0]
     elif kernel == "general":

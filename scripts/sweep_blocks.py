@@ -12,7 +12,7 @@ the one-JSON-line-per-point output contract, and the Pallas tile sweep
 state, so that section stays inline).
 
 Usage: python scripts/sweep_blocks.py [--events 800000] [--trials 100000]
-       [--kernel grid|general] [--no-poly] [--no-persist]
+       [--kernel grid|grid_mxu|general] [--no-poly] [--no-persist]
        [--pallas]  (also sweep the Pallas kernel's trial_tile/event_chunk)
 Run on the accelerator; CPU ratios do not transfer.
 """
@@ -42,7 +42,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--events", type=int, default=800_000)
     ap.add_argument("--trials", type=int, default=100_000)
-    ap.add_argument("--kernel", choices=("grid", "general"), default="grid")
+    ap.add_argument("--kernel", choices=("grid", "grid_mxu", "general"),
+                    default="grid")
     ap.add_argument("--no-poly", action="store_true",
                     help="sweep the hardware-trig path instead of poly trig")
     ap.add_argument("--no-persist", action="store_true",

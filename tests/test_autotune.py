@@ -34,6 +34,7 @@ def tuner_cache(tmp_path, monkeypatch):
     monkeypatch.delenv("CRIMP_TPU_GRID_BLOCKS", raising=False)
     monkeypatch.delenv("CRIMP_TPU_TOA_DENSE_WINDOW", raising=False)
     monkeypatch.delenv("CRIMP_TPU_MXU_BF16", raising=False)
+    monkeypatch.delenv("CRIMP_TPU_GRID_MXU", raising=False)
     return path
 
 
@@ -385,3 +386,103 @@ class TestResolveToafit:
         out = autotune.resolve_toafit(84, 10_000)
         assert out == {"err_dense_window": toafit.DENSE_WINDOW_DEFAULT,
                        "mxu_bf16": 0}
+
+
+class TestResolveGridMXU:
+    """Factorized-grid-kernel knob resolution (CRIMP_TPU_GRID_MXU):
+    env hard override in BOTH directions > cached A/B winner (unless
+    autotune is off) > default OFF; never any implicit timing."""
+
+    def test_default_off_when_nothing_cached(self, tuner_cache):
+        out = autotune.resolve_grid_mxu(800_000, 100_000)
+        assert out == {"grid_mxu": 0,
+                       "reseed": autotune.GRID_MXU_RESEED_DEFAULT,
+                       "mxu_bf16": 0}
+
+    def test_cached_winner_used_in_auto_mode(self, tuner_cache):
+        autotune.store_grid_mxu(False, 800_000, 100_000,
+                                {"grid_mxu": 1, "reseed": 128, "mxu_bf16": 0},
+                                tuner_cache)
+        out = autotune.resolve_grid_mxu(800_000, 100_000)
+        assert out["grid_mxu"] == 1 and out["reseed"] == 128
+        # size bucketing: nearby sizes share the bucket, far apart do not
+        assert autotune.resolve_grid_mxu(790_000, 100_000)["grid_mxu"] == 1
+        assert autotune.resolve_grid_mxu(1_000, 100_000)["grid_mxu"] == 0
+
+    def test_off_mode_ignores_cache_but_honors_env(
+            self, tuner_cache, monkeypatch):
+        autotune.store_grid_mxu(False, 800_000, 100_000,
+                                {"grid_mxu": 1, "reseed": 128, "mxu_bf16": 0},
+                                tuner_cache)
+        monkeypatch.setenv("CRIMP_TPU_AUTOTUNE", "0")
+        assert autotune.resolve_grid_mxu(800_000, 100_000)["grid_mxu"] == 0
+        # the env knob stays a hard override even with autotune off
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "1")
+        assert autotune.resolve_grid_mxu(800_000, 100_000)["grid_mxu"] == 1
+
+    def test_env_beats_cached_winner_both_directions(
+            self, tuner_cache, monkeypatch):
+        autotune.store_grid_mxu(False, 800_000, 100_000,
+                                {"grid_mxu": 1, "reseed": 128, "mxu_bf16": 0},
+                                tuner_cache)
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "0")
+        out = autotune.resolve_grid_mxu(800_000, 100_000)
+        assert out["grid_mxu"] == 0
+        assert out["reseed"] == 128  # un-overridden knob still cached
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "1")
+        assert autotune.resolve_grid_mxu(800_000, 100_000)["grid_mxu"] == 1
+
+    def test_env_malformed_raises(self, tuner_cache, monkeypatch):
+        # blank counts as unset (the shared _env_nonneg_int contract)
+        for bad in ("2", "yes", "on", "-1"):
+            monkeypatch.setenv("CRIMP_TPU_GRID_MXU", bad)
+            with pytest.raises(ValueError, match="CRIMP_TPU_GRID_MXU"):
+                autotune.resolve_grid_mxu(800_000, 100_000)
+
+    def test_malformed_entry_rejected(self, tuner_cache):
+        autotune.store_grid_mxu(False, 800_000, 100_000,
+                                {"grid_mxu": 1, "reseed": "often",
+                                 "mxu_bf16": 0}, tuner_cache)
+        assert autotune.cached_grid_mxu(False, 800_000, 100_000) is None
+        assert autotune.resolve_grid_mxu(800_000, 100_000)["grid_mxu"] == 0
+
+    def test_poly_and_device_keyed_separately(self, tuner_cache, monkeypatch):
+        autotune.store_grid_mxu(True, 800_000, 100_000,
+                                {"grid_mxu": 1, "reseed": 64, "mxu_bf16": 0},
+                                tuner_cache)
+        assert autotune.resolve_grid_mxu(
+            800_000, 100_000, poly=True)["grid_mxu"] == 1
+        # the hardware-trig path has its own A/B entry
+        assert autotune.resolve_grid_mxu(
+            800_000, 100_000, poly=False)["grid_mxu"] == 0
+        # another device kind never adopts this winner
+        monkeypatch.setattr(autotune, "device_fingerprint",
+                            lambda: ("tpu", "TPU v9"))
+        assert autotune.cached_grid_mxu(True, 800_000, 100_000) is None
+
+    def test_cache_failure_degrades_to_defaults(self, tuner_cache,
+                                                monkeypatch):
+        def boom(*a, **k):
+            raise RuntimeError("backend exploded")
+
+        monkeypatch.setattr(autotune, "cached_grid_mxu", boom)
+        assert autotune.resolve_grid_mxu(800_000, 100_000)["grid_mxu"] == 0
+
+    def test_enable_key_distinct_from_block_entries(self, tuner_cache):
+        # the A/B winner must not collide with the "grid_mxu" BLOCK
+        # entries the sweep persists for the same workload
+        k_enable = autotune.grid_mxu_cache_key(False, 800_000, 100_000,
+                                               "cpu", "x")
+        k_blocks = autotune.cache_key("grid_mxu", False, 800_000, 100_000,
+                                      "cpu", "x")
+        assert k_enable != k_blocks
+
+    def test_resolve_blocks_accepts_grid_mxu_kernel(self, tuner_cache,
+                                                    monkeypatch):
+        key = autotune.cache_key("grid_mxu", False, 10_000, 1000)
+        autotune._store_entry(key, {"event_block": 2048, "trial_block": 64},
+                              tuner_cache)
+        assert autotune.resolve_blocks("grid_mxu", 10_000, 1000) == (2048, 64)
+        # CRIMP_TPU_GRID_BLOCKS stays the hard override for the family
+        monkeypatch.setenv("CRIMP_TPU_GRID_BLOCKS", "8192,128")
+        assert autotune.resolve_blocks("grid_mxu", 10_000, 1000) == (8192, 128)

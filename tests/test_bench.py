@@ -64,6 +64,32 @@ class TestSubMeasurements:
         assert out["trials_per_sec_poly"] is not None
         assert out["rel_dev_poly"] < 5e-3
 
+    def test_bench_grid_mxu_tiny(self, surrogate, monkeypatch, tmp_path):
+        """The dense-vs-factorized A/B must measure both dimensionalities,
+        apply the promotion gate, stamp the accuracy fields, and persist
+        the GATED winner (whatever the gate decided on this host)."""
+        from bench import GRID_MXU_DEV_BUDGET, bench_grid_mxu
+        from crimp_tpu.ops import autotune
+
+        monkeypatch.setenv("CRIMP_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "autotune.json"))
+        monkeypatch.delenv("CRIMP_TPU_GRID_MXU", raising=False)
+        times, _ = surrogate
+        out = bench_grid_mxu(times, n_trials=512, n_fdot=2)
+        for key in ("trials_per_sec_1d_exact", "trials_per_sec_1d_mxu",
+                    "trials_per_sec_2d_exact", "trials_per_sec_2d_mxu"):
+            assert out[key] > 0, key
+        # accuracy half of the gate must hold on any host; the speedup
+        # half is a measurement, not a correctness claim
+        assert out["dev_frac_1d"] < GRID_MXU_DEV_BUDGET
+        assert out["dev_frac_2d"] < GRID_MXU_DEV_BUDGET
+        assert out["argmax_identical_1d"] and out["argmax_identical_2d"]
+        assert out["persisted"]
+        sec = (times - times.mean()) * 86400.0
+        cached = autotune.cached_grid_mxu(False, len(sec), 512)
+        assert cached is not None
+        assert cached["grid_mxu"] == int(out["promoted"])
+
     def test_bench_config4_tiny(self):
         from bench import bench_config4
 
@@ -333,8 +359,8 @@ class TestStdoutRecordDiscipline:
         def boom(*a, **k):
             raise RuntimeError("stage exploded")
 
-        for stage in ("bench_warmup", "bench_z2", "bench_toas",
-                      "bench_north_star", "bench_config4"):
+        for stage in ("bench_warmup", "bench_z2", "bench_grid_mxu",
+                      "bench_toas", "bench_north_star", "bench_config4"):
             monkeypatch.setattr(bench, stage, boom)
 
         bench.main()
@@ -346,4 +372,56 @@ class TestStdoutRecordDiscipline:
         assert record["platform"] == "cpu"
         assert record["value"] is None
         assert "toa_engine_ab" in record  # A/B slot present even on failure
-        assert set(record["errors"]) >= {"warmup", "z2", "toas"}
+        assert "grid_mxu_ab" in record
+        # the timed-region tags survive stage failure (the carried baseline
+        # must never be compared against an untagged region)
+        assert record["toa_timed_region"] == bench.TOA_TIMED_REGION
+        assert record["z2_timed_region"] == bench.Z2_TIMED_REGION
+        assert set(record["errors"]) >= {"warmup", "z2", "grid_mxu", "toas"}
+
+
+class TestBenchEnvelope:
+    """The whole worst-case bench path under a simulated driver budget:
+    relay dead, probe deadline shrunk via env, workloads shrunk via
+    CRIMP_TPU_BENCH_SCALE — the run must COMPLETE (not just emit the
+    carry line) and leave a final parseable record inside the budget.
+    The policy states are unit-tested above; this pins the ENVELOPE."""
+
+    DRIVER_BUDGET_S = 600.0
+
+    @pytest.mark.slow
+    def test_dead_relay_full_run_fits_budget(self, tmp_path):
+        import json as json_mod
+        import os
+        import subprocess
+        import time as time_mod
+
+        repo = str(pathlib.Path(__file__).parent.parent)
+        env = {**os.environ,
+               "CRIMP_TPU_RELAY_PORT": "1",  # nothing listens there
+               "CRIMP_TPU_BENCH_PROBE_DEADLINE_S": "10",
+               "CRIMP_TPU_BENCH_SCALE": "0.1",
+               "CRIMP_TPU_AUTOTUNE_CACHE": str(tmp_path / "autotune.json"),
+               "CRIMP_TPU_BENCH_PARTIAL": str(tmp_path / "partial.jsonl")}
+        # the probe path itself is part of the envelope: no platform force
+        env.pop("CRIMP_TPU_BENCH_PLATFORM", None)
+        env.pop("JAX_PLATFORMS", None)
+        t0 = time_mod.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "bench.py"], cwd=repo, env=env, text=True,
+            capture_output=True, timeout=self.DRIVER_BUDGET_S)
+        wall = time_mod.monotonic() - t0
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert wall < self.DRIVER_BUDGET_S
+        lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+        parsed = [json_mod.loads(ln) for ln in lines]  # every line JSON
+        assert parsed[0].get("carried") is True  # record-first carry line
+        record = parsed[-1]
+        assert record["platform"] == "cpu"  # dead relay -> tagged CPU run
+        assert record["cpu_scaled_workloads"] is True
+        assert record["toa_timed_region"] and record["z2_timed_region"]
+        assert "grid_mxu_ab" in record and "toa_engine_ab" in record
+        # the shrunken stages actually MEASURED (an all-errors run would
+        # trivially fit any budget)
+        assert record["value"] is not None and record["value"] > 0
+        assert record["z2_trials_per_sec"] is not None

@@ -141,3 +141,59 @@ class TestEphem:
         before = ephem.ephem_at(58099.9, params)["freqAtTmjd"]
         after = ephem.ephem_at(58100.1, params)["freqAtTmjd"]
         assert after - before == pytest.approx(1e-6, rel=1e-9)
+
+
+@pytest.mark.slow
+class TestAnchoredFoldAtScale:
+    """Cross-validate the anchored fold BEYOND the bundled-oracle span:
+    event sets spanning the config-3 (3e7 s) and config-5 (2e7 s) scale
+    baselines, checked against BOTH the longdouble straight-formula oracle
+    and an independent mpmath multi-precision evaluation (50 significant
+    digits — exact at these magnitudes). Pins the <1 us claim (1.4e-7
+    cycles at F0) at product-scale spans, and pins the longdouble oracle
+    itself against mpmath an order tighter."""
+
+    # (baseline, span_s, n_events) — spans from scripts/run_scale_configs.py
+    CASES = [("config3", 3.0e7, 400_000), ("config5", 2.0e7, 400_000)]
+    N_MPMATH = 2_000  # mpf evaluation is per-scalar; a dense subsample
+
+    @staticmethod
+    def _mpmath_fold(times_mjd, params):
+        mpmath = pytest.importorskip("mpmath")
+        from math import factorial
+
+        mp = mpmath.mp
+        with mp.workdps(50):
+            pepoch = mpmath.mpf(params["PEPOCH"])
+            coeffs = [(n, mpmath.mpf(params.get(f"F{n-1}", 0.0)))
+                      for n in range(1, 14)
+                      if params.get(f"F{n-1}", 0.0) != 0.0]
+            out = np.empty(len(times_mjd))
+            for i, t in enumerate(times_mjd):
+                dt = (mpmath.mpf(float(t)) - pepoch) * 86400
+                total = mpmath.mpf(0)
+                for n, f in coeffs:
+                    total += f / factorial(n) * dt**n
+                out[i] = float(total - mpmath.floor(total))
+        return out
+
+    @pytest.mark.parametrize("name,span_s,n_events",
+                             CASES, ids=[c[0] for c in CASES])
+    def test_crossvalidation_pins_sub_microsecond(self, name, span_s,
+                                                  n_events):
+        values, _, _ = parfile.read_timing_model(PAR)
+        rng = np.random.RandomState(31)
+        t = np.sort(values["PEPOCH"]
+                    + rng.uniform(-span_s / 2, span_s / 2, n_events) / 86400.0)
+        folded = np.asarray(anchored.fold_chunked(t, PAR))
+
+        oracle_ld = reference_fold(t, values)
+        frac_ld = (oracle_ld - np.floor(oracle_ld)).astype(np.float64)
+        assert wrap_diff(folded, frac_ld).max() < BUDGET_CYCLES, name
+
+        idx = np.linspace(0, n_events - 1, self.N_MPMATH).astype(int)
+        frac_mp = self._mpmath_fold(t[idx], values)
+        assert wrap_diff(folded[idx], frac_mp).max() < BUDGET_CYCLES, name
+        # the longdouble oracle itself must sit an order inside the budget
+        # against full precision, or the budget assertions above are void
+        assert wrap_diff(frac_ld[idx], frac_mp).max() < BUDGET_CYCLES / 10

@@ -395,3 +395,76 @@ class Test2DSharded:
                                       mesh=mesh, trig_dtype=jnp.float64)
             assert got.shape == (2, 48)
             np.testing.assert_allclose(got, expected, rtol=1e-12, atol=1e-9)
+
+
+class TestShardedGridMXU:
+    """Factorized (matmul) grid kernels under sharding.
+
+    BITWISE contract (ISSUE 3): on an event_parallel=1 mesh the f64 psum
+    is an identity, so the sharded factorized output must equal the
+    monolithic factorized kernel bit for bit — the shard-local matmuls
+    see the same rows (XLA CPU f32 dot_general is row-wise bitwise for
+    M >= 2 rows), the same sweep matrices, and — via the kernel's tile0
+    offset — the same single-f64-rounding f_tiles as the monolithic
+    expression. Blocks are pinned so both sides tile identically.
+    """
+
+    N_FREQ = 8 * 64 * 2  # 2 trial tiles per shard at trial_block=64
+
+    @pytest.fixture()
+    def pinned_blocks(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_GRID_BLOCKS", "512,64")
+        monkeypatch.delenv("CRIMP_TPU_GRID_MXU", raising=False)
+
+    def test_2d_sharded_bitmatches_monolithic_mxu(self, events, pinned_blocks):
+        freqs = np.linspace(0.14315, 0.14315 + 1e-6 * (self.N_FREQ - 1),
+                            self.N_FREQ)
+        fdots = np.array([-1e-13, 0.0])
+        f0, df = search.uniform_grid(freqs)
+        mono = np.asarray(search.z2_power_2d_grid(
+            jnp.asarray(events), f0, df, self.N_FREQ, jnp.asarray(fdots),
+            nharm=2, event_block=512, trial_block=64, mxu=True,
+            reseed=64, mxu_bf16=False))
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=1)
+        got = pmesh.z2_2d_sharded(events, freqs, fdots, nharm=2, mesh=mesh,
+                                  use_mxu=True, reseed=64, mxu_bf16=False)
+        assert got.shape == mono.shape == (2, self.N_FREQ)
+        np.testing.assert_array_equal(np.asarray(got), mono)
+
+    def test_h_sharded_bitmatches_monolithic_mxu(self, events, pinned_blocks):
+        """h_sharded runs the 2-D factorized kernel with fdots=[0], so the
+        monolithic reference must be reconstructed from the SAME kernel
+        (the 1-D kernel's phase combine differs at the signed-zero level)."""
+        nharm = 4
+        freqs = np.linspace(0.14315, 0.14315 + 1e-6 * (self.N_FREQ - 1),
+                            self.N_FREQ)
+        f0, df = search.uniform_grid(freqs)
+        c, s = search.harmonic_sums_uniform_2d_mxu(
+            jnp.asarray(events), f0, df, self.N_FREQ,
+            jnp.zeros(1), nharm, 512, 64, reseed=64, mxu_bf16=False)
+        # reduce with the same jnp ops h_sharded uses (XLA's cumsum
+        # associates differently from np.cumsum at the 1-ulp level)
+        z2_cum = jnp.cumsum(
+            search.z2_from_sums(c[0], s[0], len(events)), axis=0)
+        mono = np.asarray(jnp.max(
+            z2_cum - 4.0 * jnp.arange(nharm)[:, None], axis=0))
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=1)
+        got = pmesh.h_sharded(events, freqs, nharm=nharm, mesh=mesh,
+                              use_mxu=True, reseed=64, mxu_bf16=False)
+        np.testing.assert_array_equal(np.asarray(got), mono)
+
+    def test_2d_sharded_mxu_parity_under_event_sharding(self, events,
+                                                        pinned_blocks):
+        """With events sharded too (psum no longer an identity) the
+        factorized sharded path stays inside the statistic budget of the
+        exact sharded path and finds the same peak."""
+        freqs = np.linspace(0.14315, 0.14315 + 1e-6 * 255, 256)
+        fdots = np.array([-1e-13, 0.0])
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=4)
+        exact = np.asarray(pmesh.z2_2d_sharded(
+            events, freqs, fdots, nharm=2, mesh=mesh, use_mxu=False))
+        fact = np.asarray(pmesh.z2_2d_sharded(
+            events, freqs, fdots, nharm=2, mesh=mesh, use_mxu=True,
+            reseed=64, mxu_bf16=False))
+        assert np.max(np.abs(fact - exact)) < 0.01 * np.sqrt(4.0 * 2)
+        assert int(np.argmax(fact)) == int(np.argmax(exact))

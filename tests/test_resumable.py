@@ -260,3 +260,85 @@ class TestResumableScan:
                               chunk_trials=200)
         assert scan2.done_chunks() == [1]
         np.testing.assert_allclose(scan2.run(), full, rtol=0, atol=0)
+
+
+class TestResumableGridMXU:
+    """The factorized-kernel choice is part of a store's pinned numeric
+    mode: chunks computed by the matmul kernel must never silently mix
+    with exact-kernel chunks across a resume."""
+
+    def test_env_pins_mxu_mode_and_runs(self, events, tmp_path, monkeypatch):
+        import json
+
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "1")
+        scan = ResumableScan(events, freqs, nharm=2, store=str(store),
+                             chunk_trials=200)
+        assert scan._mxu
+        got = scan.run()
+        fp = json.loads((store / "manifest.json").read_text())
+        assert fp["numeric_mode"]["grid_mxu"][0] == 1
+        # the factorized chunks assemble to the exact statistic within
+        # the documented budget (1% of sqrt(4*nharm))
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "0")
+        exact = ResumableScan(events, freqs, nharm=2, chunk_trials=200).run()
+        assert np.max(np.abs(got - exact)) < 0.01 * np.sqrt(4.0 * 2)
+        assert int(np.argmax(got)) == int(np.argmax(exact))
+
+    def test_store_adopts_pinned_mxu_mode(self, events, tmp_path, monkeypatch):
+        """An env preference drift between sessions adopts the store's
+        pinned kernel; completed factorized chunks stay usable and the
+        resumed result is identical."""
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "1")
+        first = ResumableScan(events, freqs, nharm=2, store=str(store),
+                              chunk_trials=200)
+        power = first.run()
+        sorted(store.glob("chunk_*.npy"))[0].unlink()
+        monkeypatch.delenv("CRIMP_TPU_GRID_MXU", raising=False)
+        resumed = ResumableScan(events, freqs, nharm=2, store=str(store),
+                                chunk_trials=200)
+        assert resumed._mxu  # adopted from the store, not re-resolved
+        np.testing.assert_array_equal(resumed.run(), power)
+
+    def test_explicit_env_conflict_refuses(self, events, tmp_path,
+                                           monkeypatch):
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "1")
+        ResumableScan(events, freqs, nharm=2, store=str(store),
+                      chunk_trials=200).run()
+        # an EXPLICIT =0 against a factorized store is a hand-pinned
+        # conflict, not a preference drift
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "0")
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, store=str(store),
+                          chunk_trials=200)
+
+    def test_legacy_store_without_mxu_key_adopts_exact(self, events, tmp_path,
+                                                       monkeypatch):
+        """Pre-factorization stores carry no grid_mxu entry: resume adopts
+        the exact kernel (what those chunks were computed with) instead of
+        refusing or KeyErroring."""
+        import json
+
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.delenv("CRIMP_TPU_GRID_MXU", raising=False)
+        ResumableScan(events, freqs, nharm=2, store=str(store),
+                      chunk_trials=200).run()
+        manifest = store / "manifest.json"
+        fp = json.loads(manifest.read_text())
+        del fp["numeric_mode"]["grid_mxu"]
+        manifest.write_text(json.dumps(fp))
+        resumed = ResumableScan(events, freqs, nharm=2, store=str(store),
+                                chunk_trials=200)
+        assert not resumed._mxu
+        # an EXPLICIT =1 against the legacy exact store is a hand-pinned
+        # conflict, same as against a fresh exact store
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "1")
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, store=str(store),
+                          chunk_trials=200)

@@ -568,3 +568,192 @@ class TestStreamedGrid:
         monkeypatch.setenv("CRIMP_TPU_STREAM_MIN_EVENTS", "lots")
         with pytest.raises(ValueError, match="CRIMP_TPU_STREAM_MIN_EVENTS"):
             search.stream_min_events()
+
+
+class TestGridMXU:
+    """Factorized (matmul) grid kernels vs the exact dense kernels.
+
+    Parity budget (docs/performance.md): the factorized path adds (a) the
+    angle-addition recurrence drift of the j_lo sweep, reseeded with exact
+    sincos every `reseed` steps, and (b) f32 matmul accumulation over the
+    event block in place of the dense tree sum. Both land below the f32
+    phase-sweep error the exact fast path already carries, so the statistic
+    deviation budget is 1% of the statistic's own noise scale
+    (std of a chi^2 with 2*nharm dof = sqrt(4*nharm)) with an identical
+    argmax — the same discipline the poly-trig and bf16 gates use.
+    """
+
+    BUDGET_FRAC = 0.01
+
+    def budget(self, nharm):
+        return self.BUDGET_FRAC * np.sqrt(4.0 * nharm)
+
+    def test_1d_parity_poly_on_off(self, sim_events):
+        sec = sim_events - sim_events.mean()
+        freqs = np.linspace(0.2495, 0.2505, 733)
+        f0, df = freqs[0], float(freqs[1] - freqs[0])
+        for poly in (False, True):
+            exact = np.asarray(search.z2_power_grid(
+                sec, f0, df, len(freqs), 3, poly=poly, mxu=False))
+            fact = np.asarray(search.z2_power_grid(
+                sec, f0, df, len(freqs), 3, poly=poly, mxu=True,
+                reseed=64, mxu_bf16=False))
+            assert np.max(np.abs(fact - exact)) < self.budget(3)
+            assert int(np.argmax(fact)) == int(np.argmax(exact))
+
+    def test_h_parity_high_nharm(self, sim_events):
+        sec = sim_events - sim_events.mean()
+        freqs = np.linspace(0.2495, 0.2505, 256)
+        f0, df = freqs[0], float(freqs[1] - freqs[0])
+        exact = np.asarray(search.h_power_grid(
+            sec, f0, df, len(freqs), 20, mxu=False))
+        fact = np.asarray(search.h_power_grid(
+            sec, f0, df, len(freqs), 20, mxu=True, reseed=64,
+            mxu_bf16=False))
+        assert np.max(np.abs(fact - exact)) < self.budget(20)
+        assert int(np.argmax(fact)) == int(np.argmax(exact))
+
+    def test_2d_parity_weighted_ragged_tiles(self, sim_events):
+        """Weighted events and a final tile that only partially covers the
+        grid (n_freq not a trial_block multiple) — both must stay inside
+        the budget against the exact 2-D kernel."""
+        rng = np.random.RandomState(23)
+        sec = sim_events - sim_events.mean()
+        w = rng.uniform(0.5, 1.5, sec.shape[0])
+        n_freq = 97  # ragged at trial_block=64
+        fdots = np.array([-1e-11, 0.0, 1e-11])
+        c_e, s_e = search.harmonic_sums_uniform_2d(
+            sec, 0.2496, 1e-6, n_freq, fdots, 3,
+            event_block=1024, trial_block=64, weights=w)
+        c_f, s_f = search.harmonic_sums_uniform_2d_mxu(
+            sec, 0.2496, 1e-6, n_freq, fdots, 3,
+            event_block=1024, trial_block=64, weights=w,
+            reseed=64, mxu_bf16=False)
+        n = sec.shape[0]
+        # sums are fdot-major (n_fdot, nharm, n_freq): harmonics on axis 1
+        z_e = np.asarray(np.sum(np.asarray(
+            search.z2_from_sums(c_e, s_e, n)), axis=1))
+        z_f = np.asarray(np.sum(np.asarray(
+            search.z2_from_sums(c_f, s_f, n)), axis=1))
+        assert np.max(np.abs(z_f - z_e)) < self.budget(3)
+        assert int(np.argmax(z_f)) == int(np.argmax(z_e))
+
+    def test_reseed_stride_drift_bound(self, sim_events):
+        """The recurrence drift grows with the reseed stride; even the
+        worst case (one seed per trial block, reseed=trial_block) must stay
+        inside the budget, and the default stride must not be worse than
+        per-step exact seeding beyond the budget's headroom."""
+        sec = sim_events - sim_events.mean()
+        freqs = np.linspace(0.2495, 0.2505, 512)
+        f0, df = freqs[0], float(freqs[1] - freqs[0])
+        exact = np.asarray(search.z2_power_grid(
+            sec, f0, df, len(freqs), 2, trial_block=512, mxu=False))
+        for reseed in (1, 64, 512):
+            fact = np.asarray(search.z2_power_grid(
+                sec, f0, df, len(freqs), 2, trial_block=512, mxu=True,
+                reseed=reseed, mxu_bf16=False))
+            assert np.max(np.abs(fact - exact)) < self.budget(2), reseed
+
+    def test_streamed_bitmatches_monolithic_mxu(self):
+        rng = np.random.RandomState(11)
+        odd_times = np.sort(rng.uniform(0.0, 350.0, 5000 + 123))
+        for poly in (False, True):
+            mono = np.asarray(search.z2_power_grid(
+                odd_times, 0.2, 1e-5, 300, nharm=2,
+                event_block=512, trial_block=64, poly=poly, mxu=True,
+                reseed=64, mxu_bf16=False))
+            strm = np.asarray(search.z2_power_grid_streamed(
+                odd_times, 0.2, 1e-5, 300, nharm=2,
+                event_block=512, trial_block=64, poly=poly,
+                event_chunk=1024, mxu=True, reseed=64, mxu_bf16=False))
+            np.testing.assert_array_equal(strm, mono)
+
+    def test_2d_streamed_bitmatches_monolithic_mxu(self):
+        rng = np.random.RandomState(11)
+        odd_times = np.sort(rng.uniform(0.0, 350.0, 5000 + 123))
+        fdots = np.linspace(-1e-9, 1e-9, 3)
+        mono = np.asarray(search.z2_power_2d_grid(
+            odd_times, 0.2, 1e-5, 200, fdots, nharm=2,
+            event_block=512, trial_block=64, poly=True, mxu=True,
+            reseed=64, mxu_bf16=False))
+        strm = np.asarray(search.z2_power_2d_grid_streamed(
+            odd_times, 0.2, 1e-5, 200, fdots, nharm=2,
+            event_block=512, trial_block=64, poly=True, event_chunk=1024,
+            mxu=True, reseed=64, mxu_bf16=False))
+        np.testing.assert_array_equal(strm, mono)
+
+    def test_off_mode_exact_kernel_bit_identity(self, monkeypatch):
+        """With the knob off the wrappers must produce the exact kernel's
+        output BIT-identically (the factorized path must not perturb the
+        default numerics in any way)."""
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "0")
+        monkeypatch.delenv("CRIMP_TPU_GRID_BLOCKS", raising=False)
+        rng = np.random.RandomState(13)
+        times = np.sort(rng.uniform(0.0, 5e4, 3000))
+        c, s = search.harmonic_sums_uniform(
+            times, 0.1432, 1e-7, 300, 5, event_block=512, trial_block=64)
+        import jax.numpy as jnp
+
+        direct = np.asarray(jnp.sum(
+            search.z2_from_sums(c, s, times.shape[0]), axis=0))
+        wrapped = np.asarray(search.z2_power_grid(
+            times, 0.1432, 1e-7, 300, 5, event_block=512, trial_block=64))
+        np.testing.assert_array_equal(wrapped, direct)
+
+    def test_malformed_env_raises_through_wrapper(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "2")
+        rng = np.random.RandomState(13)
+        times = np.sort(rng.uniform(0.0, 5e4, 500))
+        with pytest.raises(ValueError, match="CRIMP_TPU_GRID_MXU"):
+            search.z2_power_grid(times, 0.1432, 1e-7, 64, 2)
+
+    def test_mxu_bf16_composes(self, sim_events):
+        """bf16 operands (f32 accumulation) stay a coarse but bounded mode:
+        same argmax on a strong signal, deviation within the bf16 mantissa
+        scale of the statistic."""
+        sec = sim_events - sim_events.mean()
+        freqs = np.linspace(0.2495, 0.2505, 256)
+        f0, df = freqs[0], float(freqs[1] - freqs[0])
+        f32 = np.asarray(search.z2_power_grid(
+            sec, f0, df, len(freqs), 2, mxu=True, reseed=64,
+            mxu_bf16=False))
+        b16 = np.asarray(search.z2_power_grid(
+            sec, f0, df, len(freqs), 2, mxu=True, reseed=64,
+            mxu_bf16=True))
+        assert int(np.argmax(b16)) == int(np.argmax(f32))
+        # bf16 has ~3 decimal digits: deviation scales with the peak power
+        assert np.max(np.abs(b16 - f32)) < 0.02 * np.max(f32)
+
+
+@pytest.mark.slow
+class TestConfig5CpuRung:
+    """Config-5 CPU validation rung of the FIXED H-test kernel (floor-based
+    phase reduction), extended from the 1% rung (docs/performance.md scale
+    table) to 10% scale: 1e7 events x 2000 trials, nharm 20, through the
+    same scripts/run_scale_configs.py plumbing the on-chip session runs.
+    Poly trig + the factorized matmul event reduction are forced — the
+    exact mode the full-scale relaunch uses — which is what makes a 2e10
+    pair rung tractable on a 1-core host."""
+
+    def test_config5_ten_percent_scale(self, monkeypatch):
+        import importlib.util
+        import pathlib
+
+        spec = importlib.util.spec_from_file_location(
+            "scale_configs",
+            pathlib.Path(__file__).parent.parent / "scripts"
+            / "run_scale_configs.py",
+        )
+        sc = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sc)
+        monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "1")
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "1")
+        out = sc.config5(0.1)
+        print("config5@10%:", out)  # rung record for the scale table (-s)
+        assert out["n_events"] == 10_000_000
+        assert out["n_trials"] == 2000
+        assert out["nharm"] == 20
+        assert out["recovers_injection"], out
+        # H grows ~linearly with the event count: the post-fix 1% rung
+        # measured H=5053, so 10% must land well past the 1% ceiling
+        assert out["peak_H"] > 20_000, out
