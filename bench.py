@@ -56,6 +56,14 @@ Z2_TIMED_REGION = "z2_grid_v1"
 GRID_MXU_SPEEDUP_GATE = 1.2
 GRID_MXU_DEV_BUDGET = 0.01  # fraction of sqrt(4*nharm)
 
+# Promotion gate for the delta-fold engine (ops/deltafold.py): the B@dp
+# refold must beat the exact anchored fold by >2x AND its max wrap-aware
+# phase deviation must stay under this fraction of the per-ToA error bar
+# (1 us, converted to cycles with the model's F0) AND the knob-off path
+# must stay bit-stable. Only then does bench persist delta_fold=1.
+DELTA_FOLD_SPEEDUP_GATE = 2.0
+DELTA_FOLD_DEV_FRAC = 0.01  # fraction of the 1 us per-ToA error bar
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -586,6 +594,108 @@ def bench_grid_mxu(times: np.ndarray, n_trials: int = 100_000,
     return out
 
 
+def bench_delta_fold(par_path: str, times: np.ndarray, intervals,
+                     persist: bool = True) -> dict:
+    """Exact-vs-delta refold A/B on the campaign surrogate with the
+    grid_mxu-style promotion gate: the delta-fold engine is only cached as
+    the winner when the refold is >2x faster than the exact anchored fold
+    AND its max wrap-aware phase deviation stays under 1% of the per-ToA
+    error bar (1 us x F0 cycles) AND the knob-off path is bit-stable. The
+    workload is the measure->fit->refold loop at the committed interval
+    layout: fold once under the campaign model, then refold under a
+    post-fit-scale update (spin + glitch-amplitude deltas, epochs fixed).
+    The gated winner persists through autotune.store_delta_fold."""
+    from crimp_tpu.models import timing
+    from crimp_tpu.ops import anchored, autotune, deltafold
+
+    tm0 = timing.resolve(par_path)
+    f = np.asarray(tm0.f, dtype=np.float64)
+    base = {"PEPOCH": float(np.asarray(tm0.pepoch)),
+            "F0": float(f[0]), "F1": float(f[1]), "F2": float(f[2])}
+    # synthetic glitches inside the campaign span: the exact path then pays
+    # the full per-event glitch/recovery evaluation a magnetar fold pays,
+    # while the refold stays one matmul whatever the glitch count
+    lo, hi = float(times.min()), float(times.max())
+    base.update({
+        "GLEP_1": lo + (hi - lo) / 3.0, "GLPH_1": 1e-3, "GLF0_1": 1e-7,
+        "GLF1_1": -1e-15, "GLF0D_1": 5e-8, "GLTD_1": 50.0,
+        "GLEP_2": lo + 2.0 * (hi - lo) / 3.0, "GLF0_2": 5e-8,
+    })
+    tm = timing.from_dict(base)
+    updated = dict(base)
+    updated["F0"] += 1e-9
+    updated["F1"] += 1e-16
+    updated["GLPH_1"] += 1e-4
+    updated["GLF0_1"] += 1e-9
+    tm_new = timing.from_dict(updated)
+
+    starts = intervals["ToA_tstart"].to_numpy()
+    ends = intervals["ToA_tend"].to_numpy()
+    seg_times = [t for t in slice_intervals(times, starts, ends) if t.size]
+    n_events = int(sum(t.size for t in seg_times))
+    dev_budget = DELTA_FOLD_DEV_FRAC * 1e-6 * float(f[0])  # cycles
+
+    def cat_fold(model, knob):
+        phases, _ = anchored.fold_segments(model, seg_times, delta_fold=knob)
+        return np.concatenate(phases)
+
+    out: dict = {"n_events": n_events, "n_segments": len(seg_times),
+                 "dev_budget_cycles": dev_budget,
+                 "budget_cycles": autotune.DELTA_FOLD_BUDGET_DEFAULT}
+
+    cat_fold(tm_new, 0)  # compile/warm the exact kernel
+    t0 = time.perf_counter()
+    p_exact = cat_fold(tm_new, 0)
+    rate_exact = n_events / (time.perf_counter() - t0)
+
+    deltafold.clear_cache()
+    cat_fold(tm, 1)  # prime: exact fold under the campaign model + store
+    t0 = time.perf_counter()
+    cat_fold(tm_new, 1)  # first refold: basis build + compile (one-time)
+    out["refold_first_s"] = round(time.perf_counter() - t0, 4)
+    t0 = time.perf_counter()
+    p_delta = cat_fold(tm_new, 1)
+    rate_delta = n_events / (time.perf_counter() - t0)
+    refold_info = deltafold.last_fold_info()
+
+    dev = np.abs(p_delta - p_exact)
+    out["max_dev_cycles"] = float(np.max(np.minimum(dev, 1.0 - dev)))
+    out["refold_mode"] = refold_info.get("mode")
+    out["bound_cycles"] = refold_info.get("bound_cycles")
+    out["events_per_sec_exact"] = rate_exact
+    out["events_per_sec_delta"] = rate_delta
+    # the off path must be deterministic: two knob-off folds bit-identical
+    out["off_bitwise_identical"] = bool(
+        np.array_equal(p_exact, cat_fold(tm_new, 0)))
+    log(f"[bench] delta_fold: exact {rate_exact:.0f} vs delta "
+        f"{rate_delta:.0f} events/s, dev {out['max_dev_cycles']:.2e} cycles "
+        f"(budget {dev_budget:.2e})")
+
+    promoted = bool(
+        rate_delta > DELTA_FOLD_SPEEDUP_GATE * rate_exact
+        and refold_info.get("mode") == "delta"
+        and out["max_dev_cycles"] < dev_budget
+        and out["off_bitwise_identical"]
+    )
+    out["promoted"] = promoted
+    out["persisted"] = False
+    if persist:
+        try:
+            autotune.store_delta_fold(n_events, {
+                "delta_fold": int(promoted),
+                "budget": autotune.DELTA_FOLD_BUDGET_DEFAULT,
+                "events_per_sec_exact": round(rate_exact, 1),
+                "events_per_sec_delta": round(rate_delta, 1),
+            })
+            out["persisted"] = True
+        except Exception as exc:  # noqa: BLE001 - persistence is best-effort
+            log(f"[bench] delta_fold winner not persisted: {exc}")
+    log(f"[bench] delta_fold gate: promoted={promoted} "
+        f"(>{DELTA_FOLD_SPEEDUP_GATE}x + dev under {dev_budget:.2e} cycles "
+        "+ off path bit-stable)")
+    return out
+
+
 def bench_north_star(par_path: str, template_path: str, times: np.ndarray, intervals,
                      n_freq: int = 2500, n_fdot: int = 40, poly_trig: bool = False) -> dict:
     """The BASELINE north star as ONE wall clock: full 2-D (nu, nudot) Z^2
@@ -830,6 +940,8 @@ def main():
     grid_mxu = step("grid_mxu", bench_grid_mxu, times,
                     n_trials=z2_trials, n_fdot=4 if on_cpu else 8)
 
+    delta_fold = step("delta_fold", bench_delta_fold, par, times, intervals)
+
     toas = step("toas", bench_toas, par, intervals_path, template, times, intervals)
     if toas:
         log(f"[bench] {toas['n_toas']} ToAs in {toas['wall_s']:.2f}s = {toas['toas_per_sec']:.1f} ToA/s "
@@ -899,6 +1011,10 @@ def main():
         # dense-vs-factorized grid kernel A/B (1-D and 2-D) with its
         # promotion gate; the gated winner persists in the autotune cache
         "grid_mxu_ab": grid_mxu,
+        # exact-vs-delta refold A/B (ops/deltafold.py) with its promotion
+        # gate (>2x + deviation under 1% of the per-ToA error bar + off
+        # path bit-stable); the gated winner persists in the autotune cache
+        "delta_fold_ab": delta_fold,
         # ToA-engine A/B: dense vs loop error scan (bit-identical bounds
         # asserted), bf16 vs f32 profile sweep (deviation-gated headline use)
         "toa_engine_ab": toas["engine_ab"] if toas else None,

@@ -282,7 +282,7 @@ def anchored_fold(am: AnchoredModel, delta: jax.Array, anchor_idx: jax.Array) ->
 # ---------------------------------------------------------------------------
 
 
-def fold_segments(timMod, seg_times, t_ref_mjd=None):
+def fold_segments(timMod, seg_times, t_ref_mjd=None, delta_fold=None):
     """Anchored fold of ragged per-segment event times in ONE device call.
 
     The ToA-pipeline fold dance — one anchor per segment, events
@@ -292,6 +292,13 @@ def fold_segments(timMod, seg_times, t_ref_mjd=None):
     (t0 + (t_end - t0)/2, the reference's ToA epoch). Returns
     (seg_phase_list, t_ref): cycle-folded [0,1) phases split back per
     segment, plus the anchors used. Empty segments fold to empty arrays.
+
+    ``delta_fold`` opts the call in/out of the incremental delta-fold
+    engine (ops/deltafold.py: fingerprinted fold cache + `phases + B@dp`
+    refolds for linear parameter updates); None defers to
+    autotune.resolve_delta_fold (CRIMP_TPU_DELTA_FOLD env > cached bench
+    A/B winner > off). With the knob off this function never touches the
+    engine and stays bit-identical to the pre-engine path.
     """
     seg_times = [np.atleast_1d(np.asarray(t, dtype=np.float64)) for t in seg_times]
     if t_ref_mjd is None:
@@ -302,13 +309,28 @@ def fold_segments(timMod, seg_times, t_ref_mjd=None):
         t_ref = np.atleast_1d(np.asarray(t_ref_mjd, dtype=np.float64))
     if not seg_times:
         return [], t_ref
-    am = prepare_anchors(timMod, t_ref)
+    tm = timing.resolve(timMod)
     sizes = [t.size for t in seg_times]
     anchor_idx = np.repeat(np.arange(len(seg_times)), sizes)
-    delta = anchor_deltas(np.concatenate(seg_times), t_ref, anchor_idx)
-    folded = np.asarray(
-        anchored_fold(am, jnp.asarray(delta), jnp.asarray(anchor_idx))
-    )
+    times_cat = np.concatenate(seg_times)
+    delta = anchor_deltas(times_cat, t_ref, anchor_idx)
+
+    def exact():
+        am = prepare_anchors(tm, t_ref)
+        return np.asarray(
+            anchored_fold(am, jnp.asarray(delta), jnp.asarray(anchor_idx))
+        )
+
+    from crimp_tpu.ops import deltafold
+
+    cfg = deltafold.resolve(times_cat.size, delta_fold)
+    if cfg["delta_fold"]:
+        folded, _ = deltafold.cached_fold(
+            tm, times_cat, sizes, t_ref, delta, anchor_idx, exact,
+            budget=cfg["budget"],
+        )
+    else:
+        folded = exact()
     return list(np.split(folded, np.cumsum(sizes)[:-1])), t_ref
 
 

@@ -400,6 +400,100 @@ def resolve_grid_mxu(n_events: int, n_trials: int, poly: bool = False) -> dict:
     return out
 
 
+# -- delta-fold knob --------------------------------------------------------
+#
+# CRIMP_TPU_DELTA_FOLD switches anchored.fold_segments between the exact
+# longdouble-anchored fold and the incremental delta-fold engine
+# (ops/deltafold.py: cached fold products refolded as `phases + B @ dp`).
+# Like grid_mxu, the switch is accuracy-gated: only bench.py's
+# deviation-checked bench_delta_fold A/B ever caches a 1, and the env var
+# stays a hard override in both directions. The cache entry also carries
+# the precision budget (cycles) the guard enforces before it will refold
+# instead of re-anchoring; CRIMP_TPU_DELTA_FOLD_BUDGET overrides it. The
+# cache key uses the kernel name "delta_fold_enable" so the entry can
+# never collide with block-size entries.
+
+DELTA_FOLD_ENV = "CRIMP_TPU_DELTA_FOLD"
+DELTA_FOLD_BUDGET_ENV = "CRIMP_TPU_DELTA_FOLD_BUDGET"
+# Guard threshold in cycles: 1e-9 sits two decades under the documented
+# <1e-8 anchored-fold budget and ~100x under a 1 us ToA error bar.
+DELTA_FOLD_BUDGET_DEFAULT = 1e-9
+
+
+def _env_pos_float(name: str) -> float | None:
+    """Parse a positive-float env knob; unset/blank -> None, malformed or
+    non-positive raises (same typo discipline as _env_nonneg_int)."""
+    env = os.environ.get(name, "").strip()
+    if not env:
+        return None
+    try:
+        val = float(env)
+    except ValueError:
+        raise ValueError(f"{name}={env!r} is not a number") from None
+    if not (0.0 < val < float("inf")):
+        raise ValueError(f"{name}={env!r} out of range (expected > 0)")
+    return val
+
+
+def delta_fold_defaults() -> dict:
+    return {"delta_fold": 0, "budget": DELTA_FOLD_BUDGET_DEFAULT}
+
+
+def delta_fold_cache_key(n_events: int,
+                         platform: str | None = None,
+                         device_kind: str | None = None) -> str:
+    return cache_key("delta_fold_enable", False, n_events, 1,
+                     platform=platform, device_kind=device_kind)
+
+
+def cached_delta_fold(n_events: int) -> dict | None:
+    entry = _load_cache().get(delta_fold_cache_key(n_events))
+    if not isinstance(entry, dict):
+        return None
+    d, b = entry.get("delta_fold"), entry.get("budget")
+    if d in (0, 1) and isinstance(b, (int, float)) and 0.0 < b < float("inf"):
+        return {"delta_fold": d, "budget": float(b)}
+    return None
+
+
+def store_delta_fold(n_events: int, entry: dict,
+                     path: pathlib.Path | None = None) -> None:
+    """Persist a gated delta-fold A/B winner (bench.py calls this)."""
+    _store_entry(delta_fold_cache_key(n_events), entry, path)
+
+
+def resolve_delta_fold(n_events: int) -> dict:
+    """Resolve {delta_fold, budget} for a fold of n_events.
+
+    Precedence per knob: CRIMP_TPU_DELTA_FOLD / CRIMP_TPU_DELTA_FOLD_BUDGET
+    (hard overrides in both directions, honored even with autotune off;
+    malformed raises) > cached A/B winner (unless CRIMP_TPU_AUTOTUNE=0) >
+    default off with DELTA_FOLD_BUDGET_DEFAULT. Never times anything —
+    the A/B with its deviation gate lives in bench.py (bench_delta_fold),
+    exactly like the grid_mxu discipline. The exact fold stays the
+    default, so an untouched install is bit-identical to the pre-engine
+    code path.
+    """
+    out = delta_fold_defaults()
+    env_d = _env_nonneg_int(DELTA_FOLD_ENV, valid=(0, 1))
+    env_b = _env_pos_float(DELTA_FOLD_BUDGET_ENV)
+    if autotune_mode() != "off":
+        try:
+            cached = cached_delta_fold(n_events)
+        except Exception:  # noqa: BLE001 — a corrupt cache or an
+            # uninitializable backend must never take down a fold call
+            logger.warning("delta_fold autotune cache lookup failed; using "
+                           "static defaults", exc_info=True)
+            cached = None
+        if cached:
+            out.update(cached)
+    if env_d is not None:
+        out["delta_fold"] = env_d
+    if env_b is not None:
+        out["budget"] = env_b
+    return out
+
+
 # -- timing / tuning --------------------------------------------------------
 
 

@@ -1,0 +1,487 @@
+"""Delta-fold engine: incremental refold via event Taylor-basis matmuls.
+
+CRIMP's real workflow is iterative — measure ToAs, fit the timing model,
+refold with the updated .par, re-measure — yet every iteration re-runs the
+full anchored fold (host longdouble prep + per-event Horner/glitch/wave
+kernel). The model phase is exactly LINEAR in the spin Taylor terms
+F0..F12 and in the glitch amplitudes (GLPH/GLF0/GLF1/GLF2/GLF0D) once the
+epochs (PEPOCH, GLEP, GLTD, wave shape) are held fixed:
+
+    phi(t; p + dp) = phi(t; p) + B(t) @ dp
+    B[e, m]   = dt_e^(m+1)/(m+1)!          (dt_e seconds from PEPOCH)
+    B[e, glitch amp] = [1, dt_g, dt_g^2/2, dt_g^3/6, tau (1 - e^{-dt_g/tau})]
+                       masked by t >= GLEP  (dt_g seconds from GLEP)
+
+and frac(phi + dphi) = frac(frac(phi) + dphi), so a refold under a
+parameter update with unchanged epochs is ONE f64 device matmul against
+the cached folded phases instead of a fresh longdouble pass:
+
+    new_folded = frac(folded + B @ dp)
+
+Error budget: the basis is built from the anchored per-event deltas
+(dt = dt_ref[a] + d_e with d_e exact f64 seconds), so each entry carries
+~1e-16 relative error; the matmul itself contributes the TPU emulated-f64
+~2^-46 per multiply (the same budget analysis as ops/anchored.py:1-31).
+The host-side guard bounds the refold error by
+
+    err <= 2^-46 * sum_k max_e |B[e,k]| * |dp_k|
+
+(the right side also bounds max|dphi|, so one bound covers both the
+roundoff and the large-update regimes) and falls back to the exact
+longdouble re-anchor whenever the bound exceeds the configured fraction
+of the ToA error budget (default 1e-9 cycles — the documented fold budget
+is <1e-8, the anchored kernel's own noise floor ~5e-9).
+
+The FINGERPRINTED FOLD CACHE keys fold products on (event-set sha, anchor
+layout sha, segment sizes, device fingerprint); a product stores the
+folded phases plus the linear parameter vector and the sha of the
+NON-linear parameters. A lookup with identical parameters returns the
+stored phases (bit-identical — the exact path is deterministic given the
+model and events); a lookup whose linear parameters moved takes the
+`B @ dp` refold when the guard admits it; anything else (epoch change,
+budget exceeded, cache off) re-runs the exact path and re-stores.
+
+Resolution discipline (ops/autotune.py): CRIMP_TPU_DELTA_FOLD env (hard
+override, malformed raises) > cached bench A/B winner (unless
+CRIMP_TPU_AUTOTUNE=0) > default OFF — the exact path stays the default
+and is bit-identical when the knob is off (it is simply never consulted).
+CRIMP_TPU_FOLD_CACHE picks the storage layer: off / in-process (default)
+/ on-disk. bench.py's bench_delta_fold owns the promotion gate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import pathlib
+from collections import OrderedDict
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crimp_tpu.models import timing
+from crimp_tpu.models.timing import N_FREQ_TERMS, TimingParams
+
+logger = logging.getLogger(__name__)
+
+SECONDS_PER_DAY = 86400.0
+# emulated-f64 multiply noise (anchored.py budget analysis)
+F64_MULT_EPS = 2.0 ** -46
+# columns per glitch: GLPH, GLF0, GLF1, GLF2, GLF0D
+N_GLITCH_AMP = 5
+
+CACHE_VERSION = 1
+_MEM_CAP = 8
+
+
+# ---------------------------------------------------------------------------
+# Linear / non-linear parameter split
+# ---------------------------------------------------------------------------
+
+
+def n_params(n_glitch: int) -> int:
+    """Basis width: 13 Taylor columns + 5 amplitude columns per glitch."""
+    return N_FREQ_TERMS + N_GLITCH_AMP * int(n_glitch)
+
+
+def linear_param_vector(tm: TimingParams) -> np.ndarray:
+    """The (13 + 5G,) vector the phase is linear in: [F0..F12] then
+    per-glitch [GLPH, GLF0, GLF1, GLF2, GLF0D] blocks (glitch-major)."""
+    f = np.asarray(tm.f, dtype=np.float64)
+    cols = [f]
+    for g in range(tm.n_glitch):
+        cols.append(np.array([
+            float(np.asarray(tm.glph)[g]),
+            float(np.asarray(tm.glf0)[g]),
+            float(np.asarray(tm.glf1)[g]),
+            float(np.asarray(tm.glf2)[g]),
+            float(np.asarray(tm.glf0d)[g]),
+        ]))
+    return np.concatenate(cols) if cols else f
+
+
+def nonlinear_sha(tm: TimingParams) -> str:
+    """sha256 over every parameter the BASIS depends on (the epochs and
+    shapes): a model whose non-linear part moved can never delta-refold."""
+    h = hashlib.sha256()
+    for arr in (
+        np.atleast_1d(np.asarray(tm.pepoch, dtype=np.float64)),
+        np.asarray(tm.glep, dtype=np.float64),
+        np.asarray(tm.gltd, dtype=np.float64),
+        np.atleast_1d(np.asarray(tm.wave_epoch, dtype=np.float64)),
+        np.atleast_1d(np.asarray(tm.wave_om, dtype=np.float64)),
+        np.asarray(tm.wave_a, dtype=np.float64),
+        np.asarray(tm.wave_b, dtype=np.float64),
+    ):
+        h.update(np.ascontiguousarray(arr).tobytes())
+        h.update(b"|")
+    return h.hexdigest()
+
+
+def delta_params(tm_old: TimingParams, tm_new: TimingParams) -> np.ndarray | None:
+    """dp = p_new - p_old when only linear parameters moved, else None."""
+    if tm_old.n_glitch != tm_new.n_glitch or tm_old.n_wave != tm_new.n_wave:
+        return None
+    if nonlinear_sha(tm_old) != nonlinear_sha(tm_new):
+        return None
+    return linear_param_vector(tm_new) - linear_param_vector(tm_old)
+
+
+# ---------------------------------------------------------------------------
+# Basis build (anchored coordinates; jittable, shard-local safe)
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class BasisSpec:
+    """Host-prepared anchor geometry the basis rows are built from (the
+    NON-linear half of the model, in anchored coordinates)."""
+
+    dt_ref_sec: jax.Array  # (A,) anchor seconds from PEPOCH (exact->f64)
+    glep_off: jax.Array  # (A, G) (t_ref - GLEP) seconds (-inf padding)
+    gltd_sec: jax.Array  # (G,) recovery timescale seconds (1 s padding)
+    glf0d_on: jax.Array  # (G,) 0 where GLTD == 0 (recovery disabled)
+    wep_off: jax.Array  # (A,) (t_ref - WAVEEPOCH) seconds
+    wave_om_sec: jax.Array  # scalar rad/s
+    wave_a: jax.Array  # (W,)
+    wave_b: jax.Array  # (W,)
+
+
+def basis_spec(tm, t_ref_mjd) -> BasisSpec:
+    """Build the BasisSpec for anchors t_ref (MJD) — mirrors the anchored
+    prepare (prepare_anchors) conventions exactly: -inf offsets for padded
+    glitches, 1 s / disabled recovery for GLTD == 0."""
+    tm = timing.resolve(tm)
+    t_ref = np.atleast_1d(np.asarray(t_ref_mjd, dtype=np.float64))
+    ld = np.longdouble
+    dt_ref = ((np.asarray(t_ref, dtype=ld) - ld(float(tm.pepoch)))
+              * ld(SECONDS_PER_DAY)).astype(np.float64)
+    glep = np.asarray(tm.glep)
+    glep_off = np.where(
+        np.isfinite(glep)[None, :],
+        (t_ref[:, None] - glep[None, :]) * SECONDS_PER_DAY,
+        -np.inf,
+    )
+    gltd = np.asarray(tm.gltd)
+    as_f64 = lambda x: np.asarray(x, dtype=np.float64)
+    return BasisSpec(
+        dt_ref_sec=as_f64(dt_ref),
+        glep_off=as_f64(glep_off),
+        gltd_sec=as_f64(np.where(gltd == 0.0, 1.0, gltd * SECONDS_PER_DAY)),
+        glf0d_on=as_f64(np.where(gltd == 0.0, 0.0, 1.0)),
+        wep_off=as_f64((t_ref - float(tm.wave_epoch)) * SECONDS_PER_DAY),
+        wave_om_sec=as_f64(float(tm.wave_om) / SECONDS_PER_DAY),
+        wave_a=as_f64(tm.wave_a),
+        wave_b=as_f64(tm.wave_b),
+    )
+
+
+@partial(jax.jit, static_argnames=("wave_in_f0",))
+def basis_rows(spec: BasisSpec, delta: jax.Array, anchor_idx: jax.Array,
+               wave_in_f0: bool = True) -> jax.Array:
+    """(N, 13 + 5G) basis rows for events at anchored second offsets.
+
+    Column m (m < 13) is dt^(m+1)/(m+1)! with dt the event's absolute
+    seconds from PEPOCH; with whitening waves and ``wave_in_f0`` the F0
+    column additionally carries the wave shape (W = F0 * shape, so
+    dphi/dF0 includes it). Glitch blocks are masked by t >= GLEP. Rows are
+    per-event independent, so the build shards along the event axis with
+    no communication (parallel/mesh.py builds them shard-local).
+    """
+    dt = spec.dt_ref_sec[anchor_idx] + delta  # (N,) seconds from PEPOCH
+    cols = []
+    acc = dt
+    cols.append(acc)
+    for m in range(2, N_FREQ_TERMS + 1):
+        acc = acc * dt / m  # dt^m / m!
+        cols.append(acc)
+    n_wave = spec.wave_a.shape[0]
+    if n_wave and wave_in_f0:
+        base = (delta + spec.wep_off[anchor_idx]) * spec.wave_om_sec
+        shape = jnp.zeros_like(delta)
+        for k in range(1, n_wave + 1):
+            shape = (shape + spec.wave_a[k - 1] * jnp.sin(k * base)
+                     + spec.wave_b[k - 1] * jnp.cos(k * base))
+        cols[0] = cols[0] + shape
+    n_glitch = spec.glep_off.shape[1]
+    for g in range(n_glitch):
+        dtg_raw = delta + spec.glep_off[anchor_idx, g]
+        after = dtg_raw >= 0.0
+        dtg = jnp.where(after, dtg_raw, 0.0)
+        tau = spec.gltd_sec[g]
+        recovery = spec.glf0d_on[g] * tau * (1.0 - jnp.exp(-dtg / tau))
+        cols.append(jnp.where(after, 1.0, 0.0))  # GLPH
+        cols.append(dtg)  # GLF0
+        cols.append(0.5 * dtg**2)  # GLF1
+        cols.append((1.0 / 6.0) * dtg**3)  # GLF2
+        cols.append(recovery)  # GLF0D
+    return jnp.stack(cols, axis=-1)
+
+
+def taylor_basis_seconds(dt_sec, order: int) -> np.ndarray:
+    """(..., order) pure-Taylor basis columns dt^m/m!, m = 1..order — the
+    rank-``order`` delta-fold a local [F0, F1] window trial scan reduces
+    to (pipelines/local_ephem.py composes it with the batched sampler)."""
+    dt = np.asarray(dt_sec, dtype=np.float64)
+    cols = []
+    acc = dt
+    for m in range(1, order + 1):
+        if m > 1:
+            acc = acc * dt / m
+        cols.append(acc)
+    return np.stack(cols, axis=-1)
+
+
+@dataclass
+class FoldBasis:
+    """Device basis matrix + the host column maxima the guard needs."""
+
+    b: jax.Array  # (N, P) device f64
+    colmax: np.ndarray  # (P,) host max_e |B[e, k]|
+
+
+def build_basis(tm, t_ref_mjd, delta, anchor_idx,
+                wave_in_f0: bool = True) -> FoldBasis:
+    """One-time basis build for an event set (device matmul operand)."""
+    spec = basis_spec(tm, t_ref_mjd)
+    b = basis_rows(spec, jnp.asarray(delta), jnp.asarray(anchor_idx),
+                   wave_in_f0=wave_in_f0)
+    colmax = np.asarray(jnp.max(jnp.abs(b), axis=0))
+    return FoldBasis(b=b, colmax=colmax)
+
+
+# ---------------------------------------------------------------------------
+# Precision budget guard + refold kernel
+# ---------------------------------------------------------------------------
+
+
+def error_bound_cycles(colmax: np.ndarray, dp: np.ndarray) -> float:
+    """Host-side bound on the refold's f64 error (cycles): 2^-46 per
+    multiply against the worst-case |dphi| = sum_k max|B_k| |dp_k|."""
+    return float(F64_MULT_EPS * np.dot(np.asarray(colmax),
+                                       np.abs(np.asarray(dp))))
+
+
+@jax.jit
+def refold(folded: jax.Array, basis: jax.Array, dp: jax.Array) -> jax.Array:
+    """frac(folded + B @ dp) — the incremental refold, one fused device
+    pass over the basis. The matvec is evaluated as a FIXED-ORDER column
+    accumulation (the column count is static and small, so this unrolls
+    into the same fused multiply-adds a matvec would issue): XLA is free
+    to re-tile a `@` reduction differently per shape, which would break
+    the sharded-vs-monolithic bitwise pin (parallel/mesh.py)."""
+    p = folded
+    for k in range(basis.shape[1]):
+        p = p + basis[:, k] * dp[k]
+    return p - jnp.floor(p)
+
+
+# ---------------------------------------------------------------------------
+# Knob resolution
+# ---------------------------------------------------------------------------
+
+
+def resolve(n_events: int, delta_fold=None, budget=None) -> dict:
+    """{'delta_fold': 0/1, 'budget': cycles} for a fold of n_events.
+
+    Explicit arguments beat the autotune resolution (env > cached bench
+    A/B winner > default off), mirroring the grid_mxu discipline.
+    """
+    from crimp_tpu.ops import autotune
+
+    out = autotune.resolve_delta_fold(n_events)
+    if delta_fold is not None:
+        out["delta_fold"] = int(bool(delta_fold))
+    if budget is not None:
+        out["budget"] = float(budget)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fingerprinted fold cache
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FoldProduct:
+    """An exact fold's reusable output: phases + the parameter split that
+    decides whether a later request can reuse/delta them. The basis and
+    the device-resident phases attach lazily on first delta use."""
+
+    phases: np.ndarray  # (N,) folded [0,1) cycles (exact-path output)
+    t_ref: np.ndarray  # (A,) anchors (MJD)
+    sizes: tuple  # per-segment event counts
+    pvec: np.ndarray  # linear parameter vector at fold time
+    nonlin: str  # nonlinear_sha at fold time
+    basis: FoldBasis | None = None
+    phases_dev: jax.Array | None = None
+
+
+_MEM_CACHE: OrderedDict[str, FoldProduct] = OrderedDict()
+_last_info: dict = {"mode": None}
+
+
+def last_fold_info() -> dict:
+    """Telemetry for the most recent cached_fold call (mode: exact /
+    cache / delta, guard bound, fallback reason)."""
+    return dict(_last_info)
+
+
+def clear_cache() -> None:
+    """Drop the in-process fold cache (tests / bench isolation)."""
+    _MEM_CACHE.clear()
+
+
+def fold_cache_mode() -> tuple[str, pathlib.Path | None]:
+    """CRIMP_TPU_FOLD_CACHE -> ('off'|'mem'|'disk', disk dir or None).
+
+    0/off disables storage entirely; unset/auto/mem keeps products
+    in-process only (default); 1/disk/on uses the default on-disk dir
+    ($XDG_CACHE_HOME/crimp_tpu/foldcache); any other value is taken as an
+    explicit on-disk directory path.
+    """
+    env = os.environ.get("CRIMP_TPU_FOLD_CACHE", "").strip()
+    low = env.lower()
+    if low in ("0", "off", "false", "never"):
+        return "off", None
+    if low in ("", "auto", "mem", "memory"):
+        return "mem", None
+    if low in ("1", "disk", "on", "true"):
+        base = os.environ.get("XDG_CACHE_HOME", "").strip() or os.path.join(
+            os.path.expanduser("~"), ".cache"
+        )
+        return "disk", pathlib.Path(base) / "crimp_tpu" / "foldcache"
+    return "disk", pathlib.Path(env)
+
+
+def fold_key(times_cat: np.ndarray, sizes, t_ref: np.ndarray) -> str:
+    """Cache key: event-set sha + segment layout + anchor sha + device
+    fingerprint (fold bits are backend-dependent, so products never cross
+    backends)."""
+    from crimp_tpu.ops import autotune
+
+    platform, device_kind = autotune.device_fingerprint()
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(
+        np.asarray(times_cat, dtype=np.float64)).tobytes())
+    h.update(("|" + ",".join(str(int(s)) for s in sizes) + "|").encode())
+    h.update(np.ascontiguousarray(
+        np.asarray(t_ref, dtype=np.float64)).tobytes())
+    h.update(f"|{platform}|{device_kind}|v{CACHE_VERSION}".encode())
+    return h.hexdigest()
+
+
+def _mem_get(key: str) -> FoldProduct | None:
+    prod = _MEM_CACHE.get(key)
+    if prod is not None:
+        _MEM_CACHE.move_to_end(key)
+    return prod
+
+
+def _mem_put(key: str, prod: FoldProduct) -> None:
+    _MEM_CACHE[key] = prod
+    _MEM_CACHE.move_to_end(key)
+    while len(_MEM_CACHE) > _MEM_CAP:
+        _MEM_CACHE.popitem(last=False)
+
+
+def _disk_get(key: str, disk_dir: pathlib.Path) -> FoldProduct | None:
+    path = disk_dir / f"{key}.npz"
+    try:
+        with np.load(path, allow_pickle=False) as doc:
+            if int(doc["version"]) != CACHE_VERSION:
+                return None
+            return FoldProduct(
+                phases=np.asarray(doc["phases"], dtype=np.float64),
+                t_ref=np.asarray(doc["t_ref"], dtype=np.float64),
+                sizes=tuple(int(s) for s in doc["sizes"]),
+                pvec=np.asarray(doc["pvec"], dtype=np.float64),
+                nonlin=str(doc["nonlin"]),
+            )
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+def _disk_put(key: str, prod: FoldProduct, disk_dir: pathlib.Path) -> None:
+    try:
+        disk_dir.mkdir(parents=True, exist_ok=True)
+        path = disk_dir / f"{key}.npz"
+        tmp = disk_dir / f"{key}.npz.tmp"
+        with open(tmp, "wb") as fh:  # np.savez(path) would append .npz
+            np.savez(fh, version=CACHE_VERSION, phases=prod.phases,
+                     t_ref=prod.t_ref, sizes=np.asarray(prod.sizes),
+                     pvec=prod.pvec, nonlin=np.str_(prod.nonlin))
+        tmp.rename(path)
+    except OSError as exc:
+        logger.warning("fold cache write failed (%s); continuing", exc)
+
+
+def _ensure_basis(prod: FoldProduct, tm, delta, anchor_idx) -> FoldBasis:
+    if prod.basis is None:
+        prod.basis = build_basis(tm, prod.t_ref, delta, anchor_idx)
+    return prod.basis
+
+
+def cached_fold(tm, times_cat, sizes, t_ref, delta, anchor_idx, exact_fn,
+                budget: float) -> tuple[np.ndarray, dict]:
+    """The engine's entry point (anchored.fold_segments calls it when the
+    knob resolves on): returns (folded phases (N,), info).
+
+    Fast paths, in order: bit-identical cache hit (stored product, same
+    linear vector, same nonlinear sha) -> ``B @ dp`` delta refold (linear
+    move within the precision budget, always relative to the stored EXACT
+    baseline so successive refolds never accumulate error) -> exact fold
+    via ``exact_fn()`` (stored as the new product).
+    """
+    global _last_info
+    tm = timing.resolve(tm)
+    mode, disk_dir = fold_cache_mode()
+    pvec = linear_param_vector(tm)
+    nonlin = nonlinear_sha(tm)
+    info: dict = {"mode": "exact", "n_events": int(np.size(times_cat))}
+    key = None
+    prod = None
+    if mode != "off":
+        key = fold_key(times_cat, sizes, t_ref)
+        info["key"] = key[:16]
+        prod = _mem_get(key)
+        if prod is None and mode == "disk":
+            prod = _disk_get(key, disk_dir)
+            if prod is not None:
+                _mem_put(key, prod)
+    if prod is not None and prod.nonlin == nonlin and \
+            prod.pvec.shape == pvec.shape:
+        dp = pvec - prod.pvec
+        if not np.any(dp):
+            info["mode"] = "cache"
+            _last_info = info
+            return prod.phases.copy(), info
+        basis = _ensure_basis(prod, tm, delta, anchor_idx)
+        bound = error_bound_cycles(basis.colmax, dp)
+        info["bound_cycles"] = bound
+        if bound <= budget:
+            if prod.phases_dev is None:
+                prod.phases_dev = jnp.asarray(prod.phases)
+            folded = np.asarray(refold(prod.phases_dev, basis.b,
+                                       jnp.asarray(dp)))
+            info["mode"] = "delta"
+            _last_info = info
+            return folded, info
+        info["fallback"] = "budget"
+    elif prod is not None:
+        info["fallback"] = "nonlinear"
+    folded = np.asarray(exact_fn())
+    if mode != "off":
+        new = FoldProduct(phases=folded, t_ref=np.asarray(t_ref),
+                          sizes=tuple(int(s) for s in sizes), pvec=pvec,
+                          nonlin=nonlin)
+        _mem_put(key, new)
+        if mode == "disk":
+            _disk_put(key, new, disk_dir)
+    _last_info = info
+    return folded, info

@@ -136,12 +136,23 @@ class ResumableScan:
             poly=self.poly,
         )
         self._blocks_explicit = autotune.env_blocks_override(kernel) is not None
+        # The delta-fold engine is numeric mode as well: a driver session
+        # that refolds via cached fold products (ops/deltafold.py) works
+        # within the engine's precision budget, one that re-anchors exactly
+        # does not — pin [on/off, budget cycles] so resumed chunks and any
+        # fold products the session reuses stay coherent.
+        self._deltafold_explicit = autotune._env_nonneg_int(
+            autotune.DELTA_FOLD_ENV, valid=(0, 1)) is not None
+        r = autotune.resolve_delta_fold(len(self.times))
+        self._delta_fold = bool(r["delta_fold"])
+        self._delta_fold_budget = float(r["budget"])
         self._numeric_mode = {
             "poly_trig": bool(self.poly),
             "grid_fastpath": bool(self._fastpath),
             "grid_blocks": list(self._blocks),
             "grid_mxu": [int(self._mxu), self._mxu_reseed,
                          int(self._mxu_bf16)],
+            "delta_fold": [int(self._delta_fold), self._delta_fold_budget],
         }
         self._times_dev = None  # lazy device-resident copy of the events
         self.store = pathlib.Path(store) if store is not None else None
@@ -189,6 +200,17 @@ class ResumableScan:
                     and store_mxu[0] in (0, 1) and store_mxu[2] in (0, 1)
                     and isinstance(store_mxu[1], int) and store_mxu[1] > 0
                 )
+                # Stores written before the delta-fold engine landed carry
+                # no pin; they were computed with it off at the default
+                # budget, so that is the adoptable default.
+                store_df = mode.get(
+                    "delta_fold", [0, autotune.DELTA_FOLD_BUDGET_DEFAULT])
+                df_ok = (
+                    isinstance(store_df, list) and len(store_df) == 2
+                    and store_df[0] in (0, 1)
+                    and isinstance(store_df[1], (int, float))
+                    and 0.0 < store_df[1] < float("inf")
+                )
                 adoptable = (
                     {k: v for k, v in existing.items() if k != "numeric_mode"}
                     == {k: v for k, v in fp.items() if k != "numeric_mode"}
@@ -211,6 +233,11 @@ class ResumableScan:
                     # silently inherit the other mode's chunks
                     and not (self._mxu_explicit
                              and bool(store_mxu[0]) != self._mxu)
+                    and df_ok
+                    # and for an explicit CRIMP_TPU_DELTA_FOLD: an exact-fold
+                    # run must not silently inherit delta-refolded products
+                    and not (self._deltafold_explicit
+                             and bool(store_df[0]) != self._delta_fold)
                 )
                 if not adoptable:
                     raise ValueError(
@@ -232,6 +259,8 @@ class ResumableScan:
                 self._mxu = bool(store_mxu[0])
                 self._mxu_reseed = int(store_mxu[1])
                 self._mxu_bf16 = bool(store_mxu[2])
+                self._delta_fold = bool(store_df[0])
+                self._delta_fold_budget = float(store_df[1])
                 self._numeric_mode = mode
         else:
             self.store.mkdir(parents=True, exist_ok=True)

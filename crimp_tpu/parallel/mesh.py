@@ -357,6 +357,49 @@ def z2_2d_sharded(
 
 
 # ---------------------------------------------------------------------------
+# Sharded delta-fold refold (basis built shard-local)
+# ---------------------------------------------------------------------------
+
+
+def delta_refold_sharded(tm, t_ref_mjd, folded, delta, anchor_idx, dp,
+                         mesh: Mesh | None = None,
+                         wave_in_f0: bool = True) -> np.ndarray:
+    """frac(folded + B @ dp) with events sharded across the mesh.
+
+    Each device builds ITS shard's basis rows (ops/deltafold.basis_rows is
+    per-event independent) and applies the refold matmul locally — the
+    full (N, 13+5G) basis never materializes on one device and there is no
+    collective (each row's dot runs over the replicated dp). Bitwise
+    identical to the monolithic refold: sharding splits the event axis,
+    not any reduction.
+    """
+    from crimp_tpu.ops import deltafold
+
+    if mesh is None:
+        mesh = Mesh(np.asarray(jax.devices()), (EVENT_AXIS,))
+    n = len(folded)
+    n_dev = mesh.shape[EVENT_AXIS]
+    spec = deltafold.basis_spec(tm, t_ref_mjd)
+    folded_p, _ = _pad_to(np.asarray(folded, dtype=np.float64), n_dev)
+    delta_p, _ = _pad_to(np.asarray(delta, dtype=np.float64), n_dev)
+    idx_p, _ = _pad_to(np.asarray(anchor_idx, dtype=np.int64), n_dev, fill=0)
+
+    def kernel(spec_rep, ph_shard, d_shard, ai_shard, dp_rep):
+        b = deltafold.basis_rows(spec_rep, d_shard, ai_shard,
+                                 wave_in_f0=wave_in_f0)
+        return deltafold.refold(ph_shard, b, dp_rep)
+
+    out = shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P(), P(EVENT_AXIS), P(EVENT_AXIS), P(EVENT_AXIS), P()),
+        out_specs=P(EVENT_AXIS),
+    )(spec, jnp.asarray(folded_p), jnp.asarray(delta_p), jnp.asarray(idx_p),
+      jnp.asarray(np.asarray(dp, dtype=np.float64)))
+    return np.asarray(out)[:n]
+
+
+# ---------------------------------------------------------------------------
 # Segment-axis (data-parallel) placement
 # ---------------------------------------------------------------------------
 

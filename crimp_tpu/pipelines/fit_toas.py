@@ -386,9 +386,16 @@ def fit_toas(
         uncertainties = None
         source_label = "Maximum Likelihood Estimation"
 
-    post_fit = fit_utils.model_phase_residuals(
+    # post-fit refold: the delta-fold engine serves it as one basis matmul
+    # when the free set is linear and the knob is on; None falls back to
+    # the exact host-longdouble path (bit-identical when the knob is off)
+    post_fit = fit_utils.model_phase_residuals_delta(
         toas_pre_fit["ToA"].to_numpy(), init_par, best_vec, keys
     )
+    if post_fit is None:
+        post_fit = fit_utils.model_phase_residuals(
+            toas_pre_fit["ToA"].to_numpy(), init_par, best_vec, keys
+        )
     if residual_plot is not None:
         suffix = f"_{best_fit}" if mcmc else ""
         plot_residuals(toas_pre_fit, post_fit, residual_plot + suffix)

@@ -177,6 +177,89 @@ def model_phase_residuals(x_mjd, timmodel: dict, pvec, keys: list[str]) -> np.nd
     return phases - np.mean(phases)
 
 
+_LINEAR_F_RE = re.compile(r"^F(\d+)$")
+_LINEAR_GL_RE = re.compile(r"^(GLPH|GLF0D|GLF0|GLF1|GLF2)_(\S+)$")
+_GL_COL = {"GLPH": 0, "GLF0": 1, "GLF1": 2, "GLF2": 3, "GLF0D": 4}
+
+
+def model_phase_residuals_delta(x_mjd, timmodel: dict, pvec, keys: list[str],
+                                cfg: dict | None = None) -> np.ndarray | None:
+    """Delta-fold fast path for model_phase_residuals: B @ dp as one f64
+    device matmul (ops/deltafold.py basis, single anchor at PEPOCH).
+
+    The delta parameterization makes the objective LINEAR in the free
+    spin/glitch-amplitude deltas, so the residual model is exactly a basis
+    matmul; frozen whitening waves are added host-side unchanged (they do
+    not depend on the free deltas — wave fits keep the exact path).
+    Returns None whenever ineligible — knob off, a free key outside the
+    linear family (epochs, GLTD, waves), or the predicted f64 error bound
+    above the configured budget — and the caller falls back to the exact
+    host-longdouble path.
+    """
+    from crimp_tpu.ops import deltafold
+
+    t = np.atleast_1d(np.asarray(x_mjd, dtype=np.float64))
+    if cfg is None:
+        cfg = deltafold.resolve(t.size)
+    if not cfg["delta_fold"] or not keys:
+        return None
+    parsed = []
+    for key in keys:
+        m = _LINEAR_F_RE.match(key)
+        if m:
+            idx = int(m.group(1))
+            if idx >= timing.N_FREQ_TERMS:
+                return None
+            parsed.append(("f", idx))
+            continue
+        m = _LINEAR_GL_RE.match(key)
+        if m:
+            parsed.append((m.group(1), m.group(2)))
+            continue
+        return None
+
+    fit_dict, full_dict = inject_free_params(timmodel, pvec, keys)
+    # fit-path semantics: deltas evaluate on the fit dict (base epochs,
+    # GLTD zeroed in delta space — recovery columns inert, matching
+    # _host_glitch_phase on fit_tm), waves frozen at their FULL values
+    fit_tm = timing.from_dict(fit_dict)
+    gids = [mm.group(1) for k in fit_dict
+            if (mm := re.match(r"GLEP_(\S+)$", k))]
+    dp = np.zeros(deltafold.n_params(fit_tm.n_glitch))
+    for (kind, which), val in zip(parsed, np.asarray(pvec, dtype=np.float64)):
+        if kind == "f":
+            dp[which] = val
+        else:
+            if which not in gids:
+                return None
+            dp[timing.N_FREQ_TERMS
+               + deltafold.N_GLITCH_AMP * gids.index(which)
+               + _GL_COL[kind]] = val
+
+    import jax.numpy as jnp
+
+    pepoch = float(np.asarray(fit_tm.pepoch))
+    delta_sec = np.asarray(
+        (np.asarray(t, dtype=np.longdouble) - np.longdouble(pepoch))
+        * np.longdouble(anchored.SECONDS_PER_DAY),
+        dtype=np.float64,
+    )
+    spec = deltafold.basis_spec(fit_tm, np.asarray([pepoch]))
+    anchor_idx = np.zeros(t.size, dtype=np.int64)
+    b = deltafold.basis_rows(spec, jnp.asarray(delta_sec),
+                             jnp.asarray(anchor_idx), wave_in_f0=False)
+    colmax = np.asarray(jnp.max(jnp.abs(b), axis=0))
+    if deltafold.error_bound_cycles(colmax, dp) > cfg["budget"]:
+        return None
+    phases = np.asarray(b @ jnp.asarray(dp), dtype=np.float64)
+    full_tm = timing.from_dict(full_dict)
+    if full_tm.n_wave:
+        phases = phases + np.asarray(
+            anchored._host_wave_phase(full_tm, t), dtype=np.float64
+        )
+    return phases - np.mean(phases)
+
+
 def make_nll(x, y, y_err, parfile: dict, yaml_init: str | None = None):
     """(nll(pvec), p0, keys, parfile) — the MLE objective factory."""
     validate_parfile(parfile)

@@ -24,6 +24,7 @@ import pandas as pd
 from crimp_tpu.io import parfile as parfile_io
 from crimp_tpu.io import tim as tim_io
 from crimp_tpu.models import timing
+from crimp_tpu.ops import deltafold
 from crimp_tpu.ops import mcmc as mcmc_ops
 from crimp_tpu.ops.ephem import integer_rotation_host
 from crimp_tpu.pipelines import fit_utils
@@ -36,16 +37,19 @@ FIT_KEYS = ["F0", "F1"]
 
 
 def _window_log_prob(theta, data):
-    """Delta-parameterized local model: mu = d0*dt + d1*dt^2/2 (seconds from
-    the window anchor), mean-subtracted over valid ToAs — the 2-free-param
-    specialization of fit_toas.make_logprob, masked for padding."""
+    """Delta-parameterized local model: mu = basis @ theta with the rank-2
+    Taylor basis [dt, dt^2/2] (seconds from the window anchor) — a window's
+    [dF0, dF1] trial is exactly a rank-2 delta-fold (ops/deltafold.py
+    taylor_basis_seconds), so the per-trial model is one small matmul —
+    mean-subtracted over valid ToAs, masked for padding."""
     import jax.numpy as jnp
 
-    dt, y, err, mask, lo, hi = (
-        data["dt"], data["y"], data["err"], data["mask"], data["lo"], data["hi"]
+    basis, y, err, mask, lo, hi = (
+        data["basis"], data["y"], data["err"], data["mask"], data["lo"],
+        data["hi"],
     )
     in_box = jnp.all((theta > lo) & (theta < hi))
-    mu = theta[0] * dt + 0.5 * theta[1] * dt**2
+    mu = basis @ theta
     mu = mu - jnp.sum(mu * mask) / jnp.sum(mask)
     resid = (y - mu) / err
     nll = 0.5 * jnp.sum(mask * (resid**2 + jnp.log(2 * jnp.pi * err**2)))
@@ -80,7 +84,8 @@ def _fit_windows_batched(windows: list[dict], steps: int, burn: int, walkers: in
             p0[i, :, d] = rng.uniform(lo[i, d], hi[i, d], size=walkers)
 
     data = {
-        "dt": jnp.asarray(dt), "y": jnp.asarray(y), "err": jnp.asarray(err),
+        "basis": jnp.asarray(deltafold.taylor_basis_seconds(dt, 2)),
+        "y": jnp.asarray(y), "err": jnp.asarray(err),
         "mask": jnp.asarray(mask), "lo": jnp.asarray(lo), "hi": jnp.asarray(hi),
     }
     chains, lps = mcmc_ops.ensemble_sample_batch(

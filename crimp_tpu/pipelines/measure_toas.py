@@ -23,7 +23,7 @@ import pandas as pd
 from crimp_tpu.io import template as template_io
 from crimp_tpu.io.events import EventFile
 from crimp_tpu.models import profiles, timing
-from crimp_tpu.ops import anchored, search, toafit
+from crimp_tpu.ops import anchored, deltafold, search, toafit
 from crimp_tpu.ops.ephem import spin_frequency_host
 from crimp_tpu.utils.logging import get_logger
 from crimp_tpu.utils.profiling import timed, trace
@@ -102,6 +102,12 @@ def measure_toas(
     seg_sizes = [t.size for t in seg_times]
     with timed("anchored_fold"):
         seg_phase_list, toa_mids = anchored.fold_segments(tm, seg_times)
+    fold_info = deltafold.last_fold_info()
+    if fold_info.get("mode") in ("cache", "delta"):
+        # re-measure under an updated .par reused the fingerprinted fold
+        # product (pure hit or B@dp refold) instead of a fresh exact fold
+        logger.info("delta-fold engine served the re-measure fold: %s",
+                    fold_info)
     if kind in (profiles.CAUCHY, profiles.VONMISES):
         # radians convention for these families (measureToAs.py:195-200)
         seg_phase_list = [p * (2 * np.pi) for p in seg_phase_list]

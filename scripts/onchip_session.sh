@@ -58,6 +58,17 @@ ensure_healthy() {
     # burning the remaining window sleeping here would also starve
     # extract_rates of any chance to run (round 5 lost the whole window to
     # exactly this recovery loop).
+    #
+    # The guard covers the ENTRY probe too: health_ok itself can hang its
+    # full 300 s timeout, so when even that would overrun the deadline,
+    # don't probe at all — the chip must be free at the deadline and a
+    # wedged probe is chip-holding time.
+    if [ -n "${CRIMP_TPU_SESSION_DEADLINE:-}" ] \
+        && [ $(( $(date +%s) + 300 )) -gt "$CRIMP_TPU_SESSION_DEADLINE" ]; then
+        echo "--- abandoning relay recovery: even one probe (300 s) would overrun session deadline ---" \
+            | tee -a "$OUT/session.log"
+        return 1
+    fi
     health_ok && return 0
     echo "--- relay unhealthy at $(date -u +%H:%M:%S); waiting for grant expiry ---" \
         | tee -a "$OUT/session.log"

@@ -35,6 +35,8 @@ def tuner_cache(tmp_path, monkeypatch):
     monkeypatch.delenv("CRIMP_TPU_TOA_DENSE_WINDOW", raising=False)
     monkeypatch.delenv("CRIMP_TPU_MXU_BF16", raising=False)
     monkeypatch.delenv("CRIMP_TPU_GRID_MXU", raising=False)
+    monkeypatch.delenv("CRIMP_TPU_DELTA_FOLD", raising=False)
+    monkeypatch.delenv("CRIMP_TPU_DELTA_FOLD_BUDGET", raising=False)
     return path
 
 
@@ -476,6 +478,81 @@ class TestResolveGridMXU:
         k_blocks = autotune.cache_key("grid_mxu", False, 800_000, 100_000,
                                       "cpu", "x")
         assert k_enable != k_blocks
+
+
+class TestResolveDeltaFold:
+    """Delta-fold engine knob resolution (CRIMP_TPU_DELTA_FOLD +
+    CRIMP_TPU_DELTA_FOLD_BUDGET): env hard override in BOTH directions >
+    cached bench A/B winner (unless autotune is off) > default OFF at the
+    static budget; never any implicit timing."""
+
+    def test_default_off_when_nothing_cached(self, tuner_cache):
+        assert autotune.resolve_delta_fold(800_000) == {
+            "delta_fold": 0, "budget": autotune.DELTA_FOLD_BUDGET_DEFAULT}
+
+    def test_cached_winner_used_in_auto_mode(self, tuner_cache):
+        autotune.store_delta_fold(800_000, {"delta_fold": 1, "budget": 2e-9},
+                                  tuner_cache)
+        out = autotune.resolve_delta_fold(800_000)
+        assert out["delta_fold"] == 1 and out["budget"] == 2e-9
+        # size bucketing: nearby sizes share the bucket, far apart do not
+        assert autotune.resolve_delta_fold(790_000)["delta_fold"] == 1
+        assert autotune.resolve_delta_fold(1_000)["delta_fold"] == 0
+
+    def test_off_mode_ignores_cache_but_honors_env(
+            self, tuner_cache, monkeypatch):
+        autotune.store_delta_fold(800_000, {"delta_fold": 1, "budget": 2e-9},
+                                  tuner_cache)
+        monkeypatch.setenv("CRIMP_TPU_AUTOTUNE", "0")
+        assert autotune.resolve_delta_fold(800_000)["delta_fold"] == 0
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD", "1")
+        assert autotune.resolve_delta_fold(800_000)["delta_fold"] == 1
+
+    def test_env_beats_cached_winner_both_directions(
+            self, tuner_cache, monkeypatch):
+        autotune.store_delta_fold(800_000, {"delta_fold": 1, "budget": 2e-9},
+                                  tuner_cache)
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD", "0")
+        out = autotune.resolve_delta_fold(800_000)
+        assert out["delta_fold"] == 0
+        assert out["budget"] == 2e-9  # un-overridden knob still cached
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD", "1")
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD_BUDGET", "5e-10")
+        out = autotune.resolve_delta_fold(800_000)
+        assert out == {"delta_fold": 1, "budget": 5e-10}
+
+    def test_env_malformed_raises(self, tuner_cache, monkeypatch):
+        for bad in ("2", "yes", "on", "-1"):
+            monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD", bad)
+            with pytest.raises(ValueError, match="CRIMP_TPU_DELTA_FOLD"):
+                autotune.resolve_delta_fold(800_000)
+        monkeypatch.delenv("CRIMP_TPU_DELTA_FOLD")
+        for bad in ("zero", "0", "-1e-9", "inf"):
+            monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD_BUDGET", bad)
+            with pytest.raises(ValueError,
+                               match="CRIMP_TPU_DELTA_FOLD_BUDGET"):
+                autotune.resolve_delta_fold(800_000)
+
+    def test_malformed_entry_rejected(self, tuner_cache):
+        autotune.store_delta_fold(800_000, {"delta_fold": 1, "budget": "lax"},
+                                  tuner_cache)
+        assert autotune.cached_delta_fold(800_000) is None
+        assert autotune.resolve_delta_fold(800_000)["delta_fold"] == 0
+
+    def test_device_keyed_separately(self, tuner_cache, monkeypatch):
+        autotune.store_delta_fold(800_000, {"delta_fold": 1, "budget": 2e-9},
+                                  tuner_cache)
+        monkeypatch.setattr(autotune, "device_fingerprint",
+                            lambda: ("tpu", "TPU v9"))
+        assert autotune.cached_delta_fold(800_000) is None
+
+    def test_cache_failure_degrades_to_defaults(self, tuner_cache,
+                                                monkeypatch):
+        def boom(*a, **k):
+            raise RuntimeError("backend exploded")
+
+        monkeypatch.setattr(autotune, "cached_delta_fold", boom)
+        assert autotune.resolve_delta_fold(800_000)["delta_fold"] == 0
 
     def test_resolve_blocks_accepts_grid_mxu_kernel(self, tuner_cache,
                                                     monkeypatch):

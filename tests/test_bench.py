@@ -90,6 +90,51 @@ class TestSubMeasurements:
         assert cached is not None
         assert cached["grid_mxu"] == int(out["promoted"])
 
+    def test_bench_delta_fold_tiny(self, surrogate, monkeypatch, tmp_path):
+        """The exact-vs-delta refold A/B must measure both paths, apply the
+        promotion gate (>2x refold speedup AND dev under 1% of the per-ToA
+        error bar AND off path bit-stable), and persist the GATED winner.
+        The accuracy half must hold on any host; the speedup half is a
+        measurement, not a correctness claim."""
+        from bench import (DELTA_FOLD_DEV_FRAC, DELTA_FOLD_SPEEDUP_GATE,
+                           bench_delta_fold)
+        from crimp_tpu.ops import autotune, deltafold
+
+        monkeypatch.setenv("CRIMP_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "autotune.json"))
+        monkeypatch.delenv("CRIMP_TPU_DELTA_FOLD", raising=False)
+        monkeypatch.delenv("CRIMP_TPU_DELTA_FOLD_BUDGET", raising=False)
+        monkeypatch.delenv("CRIMP_TPU_FOLD_CACHE", raising=False)
+        times, intervals = surrogate
+        try:
+            out = bench_delta_fold(PAR, times, intervals)
+        finally:
+            deltafold.clear_cache()
+        assert out["events_per_sec_exact"] > 0
+        assert out["events_per_sec_delta"] > 0
+        # the engine must actually have served the timed refold via the
+        # linear path (not a guard fallback) ...
+        assert out["refold_mode"] == "delta"
+        # ... within the accuracy gate and with a deterministic off path
+        assert out["max_dev_cycles"] < out["dev_budget_cycles"]
+        assert out["dev_budget_cycles"] == pytest.approx(
+            DELTA_FOLD_DEV_FRAC * 1e-6 * 0.1432, rel=1e-2)
+        assert out["off_bitwise_identical"]
+        # the promotion gate LOGIC is enforced here: promoted iff every
+        # clause held, including the >2x speedup measurement on this host
+        assert out["promoted"] == (
+            out["events_per_sec_delta"]
+            > DELTA_FOLD_SPEEDUP_GATE * out["events_per_sec_exact"]
+            and out["refold_mode"] == "delta"
+            and out["max_dev_cycles"] < out["dev_budget_cycles"]
+            and out["off_bitwise_identical"]
+        )
+        assert out["persisted"]
+        cached = autotune.cached_delta_fold(out["n_events"])
+        assert cached is not None
+        assert cached["delta_fold"] == int(out["promoted"])
+        assert cached["budget"] == autotune.DELTA_FOLD_BUDGET_DEFAULT
+
     def test_bench_config4_tiny(self):
         from bench import bench_config4
 
@@ -360,7 +405,8 @@ class TestStdoutRecordDiscipline:
             raise RuntimeError("stage exploded")
 
         for stage in ("bench_warmup", "bench_z2", "bench_grid_mxu",
-                      "bench_toas", "bench_north_star", "bench_config4"):
+                      "bench_delta_fold", "bench_toas", "bench_north_star",
+                      "bench_config4"):
             monkeypatch.setattr(bench, stage, boom)
 
         bench.main()
@@ -373,11 +419,13 @@ class TestStdoutRecordDiscipline:
         assert record["value"] is None
         assert "toa_engine_ab" in record  # A/B slot present even on failure
         assert "grid_mxu_ab" in record
+        assert "delta_fold_ab" in record
         # the timed-region tags survive stage failure (the carried baseline
         # must never be compared against an untagged region)
         assert record["toa_timed_region"] == bench.TOA_TIMED_REGION
         assert record["z2_timed_region"] == bench.Z2_TIMED_REGION
-        assert set(record["errors"]) >= {"warmup", "z2", "grid_mxu", "toas"}
+        assert set(record["errors"]) >= {"warmup", "z2", "grid_mxu",
+                                         "delta_fold", "toas"}
 
 
 class TestBenchEnvelope:

@@ -1,0 +1,387 @@
+"""Delta-fold engine tests (ops/deltafold.py + its wiring).
+
+Covers the ISSUE 4 acceptance criteria: longdouble-oracle parity of
+`B @ dp` refolds across spin/glitch updates, the forced exact fallback
+when the predicted |dphi| bound exceeds the budget, fold-cache hit and
+invalidation on event-set / par fingerprint changes, knob-off bitwise
+identity with the pre-engine path, and the 8-device sharded-vs-monolithic
+bitwise pin.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from conftest import reference_fold
+
+from crimp_tpu.models import timing
+from crimp_tpu.ops import anchored, autotune, deltafold
+
+BASE = {
+    "PEPOCH": 58359.55765869704,
+    "F0": 0.14328254547263483,
+    "F1": -9.746993965547238e-15,
+    "F2": 1.3624129994547033e-23,
+    # two glitches inside the test span, one with an exponential recovery
+    "GLEP_1": 58400.0, "GLPH_1": 0.01, "GLF0_1": 3e-8, "GLF1_1": -1e-15,
+    "GLF0D_1": 2e-8, "GLTD_1": 40.0,
+    "GLEP_2": 58600.0, "GLF0_2": 1e-8,
+}
+
+
+def _segments(n_per=2000, n_seg=4, seed=0):
+    rng = np.random.default_rng(seed)
+    segs = []
+    for i in range(n_seg):
+        lo = 58320.0 + 120.0 * i
+        segs.append(np.sort(lo + rng.uniform(0.0, 100.0, n_per)))
+    return segs
+
+
+def _wrap_dev(a, b):
+    d = np.abs(np.asarray(a) - np.asarray(b))
+    return float(np.max(np.minimum(d, 1.0 - d)))
+
+
+def _frac(x):
+    return np.asarray(x - np.floor(x), dtype=np.float64)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_engine(monkeypatch):
+    """Every test starts with an empty in-process fold cache and no stray
+    delta-fold env knobs (the autotune cache is already tmp-isolated by
+    conftest; CRIMP_TPU_AUTOTUNE=0 keeps any bench-persisted winner from
+    leaking into default resolution)."""
+    deltafold.clear_cache()
+    for var in ("CRIMP_TPU_DELTA_FOLD", "CRIMP_TPU_DELTA_FOLD_BUDGET",
+                "CRIMP_TPU_FOLD_CACHE"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("CRIMP_TPU_AUTOTUNE", "0")
+    yield
+    deltafold.clear_cache()
+
+
+class TestBasisAndGuard:
+    def test_linear_param_vector_layout(self):
+        tm = timing.from_dict(BASE)
+        p = deltafold.linear_param_vector(tm)
+        assert p.shape == (deltafold.n_params(2),)
+        assert p[0] == BASE["F0"] and p[1] == BASE["F1"]
+        # glitch-major blocks: [GLPH, GLF0, GLF1, GLF2, GLF0D] per glitch
+        assert p[13] == BASE["GLPH_1"] and p[14] == BASE["GLF0_1"]
+        assert p[17] == BASE["GLF0D_1"]
+        assert p[19] == BASE["GLF0_2"] and p[18] == 0.0
+
+    def test_nonlinear_sha_tracks_epochs_only(self):
+        tm = timing.from_dict(BASE)
+        moved_amp = timing.from_dict({**BASE, "GLF0_1": 9e-8})
+        moved_epoch = timing.from_dict({**BASE, "GLEP_1": 58401.0})
+        assert deltafold.nonlinear_sha(tm) == deltafold.nonlinear_sha(moved_amp)
+        assert deltafold.nonlinear_sha(tm) != deltafold.nonlinear_sha(moved_epoch)
+
+    def test_error_bound_scales_with_update(self):
+        colmax = np.array([1e7, 1e12])
+        small = deltafold.error_bound_cycles(colmax, np.array([1e-9, 0.0]))
+        large = deltafold.error_bound_cycles(colmax, np.array([1e-3, 1e-14]))
+        assert small == pytest.approx(2.0**-46 * 1e-2)
+        assert large > small
+
+    def test_taylor_basis_seconds(self):
+        dt = np.linspace(-5e4, 5e4, 101)
+        b = deltafold.taylor_basis_seconds(dt, 2)
+        assert b.shape == (101, 2)
+        theta = np.array([3e-9, -1e-16])
+        np.testing.assert_allclose(
+            b @ theta, theta[0] * dt + 0.5 * theta[1] * dt**2, rtol=1e-14)
+
+
+class TestRefoldParity:
+    @pytest.mark.parametrize("update", [
+        {"F0": 3e-10, "F1": 2e-17},                      # spin-only
+        {"GLPH_1": 1e-3, "GLF0_1": 5e-10, "GLF0D_1": 1e-9,
+         "GLF0_2": -3e-10},                              # glitch-amp-only
+        {"F0": -2e-10, "F2": 1e-25, "GLF1_1": 3e-17,
+         "GLPH_1": -5e-4},                               # combined
+    ])
+    def test_refold_matches_longdouble_oracle(self, update):
+        segs = _segments()
+        tm = timing.from_dict(BASE)
+        anchored.fold_segments(tm, segs, delta_fold=1)  # prime the product
+        new_pars = {k: BASE.get(k, 0.0) + dv for k, dv in update.items()}
+        tm_new = timing.from_dict({**BASE, **new_pars})
+        ph, _ = anchored.fold_segments(tm_new, segs, delta_fold=1)
+        info = deltafold.last_fold_info()
+        assert info["mode"] == "delta"
+        t = np.concatenate(segs)
+        oracle = _frac(reference_fold(t, {**BASE, **new_pars}))
+        # acceptance budget: within 1e-8 cycles of the longdouble fold
+        assert _wrap_dev(np.concatenate(ph), oracle) < 1e-8
+
+    def test_refold_matches_oracle_with_waves(self):
+        pars = {**BASE, "WAVEEPOCH": 58360.0, "WAVE_OM": 0.0075,
+                "WAVE1": {"A": 2e-3, "B": -1e-3}, "WAVE2": {"A": 5e-4, "B": 0.0}}
+        segs = _segments(n_per=1000)
+        anchored.fold_segments(timing.from_dict(pars), segs, delta_fold=1)
+        # an F0 move must pick up the wave shape through the F0 column
+        # (W = F0 * shape in the phase model)
+        new_pars = {**pars, "F0": pars["F0"] + 4e-10}
+        ph, _ = anchored.fold_segments(timing.from_dict(new_pars), segs,
+                                       delta_fold=1)
+        assert deltafold.last_fold_info()["mode"] == "delta"
+        oracle = _frac(reference_fold(np.concatenate(segs), new_pars))
+        assert _wrap_dev(np.concatenate(ph), oracle) < 1e-8
+
+    def test_successive_refolds_use_the_exact_baseline(self):
+        """Refolds always delta against the stored EXACT product, so a
+        chain of updates cannot accumulate refold error."""
+        segs = _segments(n_per=500)
+        anchored.fold_segments(timing.from_dict(BASE), segs, delta_fold=1)
+        pars = dict(BASE)
+        for step in range(5):
+            pars = {**pars, "F0": pars["F0"] + 1e-10}
+            ph, _ = anchored.fold_segments(timing.from_dict(pars), segs,
+                                           delta_fold=1)
+            assert deltafold.last_fold_info()["mode"] == "delta"
+        oracle = _frac(reference_fold(np.concatenate(segs), pars))
+        assert _wrap_dev(np.concatenate(ph), oracle) < 1e-8
+
+
+class TestGuardFallback:
+    def test_budget_exceeded_falls_back_to_exact(self, monkeypatch):
+        segs = _segments(n_per=500)
+        anchored.fold_segments(timing.from_dict(BASE), segs, delta_fold=1)
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD_BUDGET", "1e-30")
+        tm_new = timing.from_dict({**BASE, "F0": BASE["F0"] + 1e-10})
+        ph, _ = anchored.fold_segments(tm_new, segs, delta_fold=1)
+        info = deltafold.last_fold_info()
+        assert info["mode"] == "exact"
+        assert info["fallback"] == "budget"
+        assert info["bound_cycles"] > 1e-30
+        # the exact fallback is bit-identical to the knob-off fold
+        deltafold.clear_cache()
+        ph_off, _ = anchored.fold_segments(tm_new, segs, delta_fold=0)
+        for a, b in zip(ph, ph_off):
+            assert np.array_equal(a, b)
+
+    def test_within_budget_bound_also_bounds_true_error(self):
+        segs = _segments(n_per=500)
+        anchored.fold_segments(timing.from_dict(BASE), segs, delta_fold=1)
+        tm_new = timing.from_dict({**BASE, "F0": BASE["F0"] + 1e-10})
+        ph, _ = anchored.fold_segments(tm_new, segs, delta_fold=1)
+        info = deltafold.last_fold_info()
+        assert info["mode"] == "delta"
+        assert info["bound_cycles"] <= autotune.DELTA_FOLD_BUDGET_DEFAULT
+
+    def test_malformed_env_raises(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD_BUDGET", "tiny")
+        with pytest.raises(ValueError):
+            autotune.resolve_delta_fold(1000)
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD_BUDGET", "-1e-9")
+        with pytest.raises(ValueError):
+            autotune.resolve_delta_fold(1000)
+
+
+class TestFoldCache:
+    def test_pure_hit_is_bitwise(self):
+        segs = _segments(n_per=500)
+        tm = timing.from_dict(BASE)
+        ph1, _ = anchored.fold_segments(tm, segs, delta_fold=1)
+        ph2, _ = anchored.fold_segments(tm, segs, delta_fold=1)
+        assert deltafold.last_fold_info()["mode"] == "cache"
+        for a, b in zip(ph1, ph2):
+            assert np.array_equal(a, b)
+
+    def test_event_set_change_invalidates(self):
+        segs = _segments(n_per=500)
+        tm = timing.from_dict(BASE)
+        anchored.fold_segments(tm, segs, delta_fold=1)
+        other = [s + 1e-6 for s in segs]
+        anchored.fold_segments(tm, other, delta_fold=1)
+        assert deltafold.last_fold_info()["mode"] == "exact"
+
+    def test_nonlinear_change_invalidates(self):
+        segs = _segments(n_per=500)
+        anchored.fold_segments(timing.from_dict(BASE), segs, delta_fold=1)
+        moved = timing.from_dict({**BASE, "GLEP_1": 58401.0})
+        anchored.fold_segments(moved, segs, delta_fold=1)
+        info = deltafold.last_fold_info()
+        assert info["mode"] == "exact"
+        assert info["fallback"] == "nonlinear"
+
+    def test_cache_off_never_stores(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_FOLD_CACHE", "0")
+        segs = _segments(n_per=500)
+        tm = timing.from_dict(BASE)
+        anchored.fold_segments(tm, segs, delta_fold=1)
+        anchored.fold_segments(tm, segs, delta_fold=1)
+        assert deltafold.last_fold_info()["mode"] == "exact"
+
+    def test_disk_cache_survives_process_cache_loss(self, monkeypatch,
+                                                    tmp_path):
+        monkeypatch.setenv("CRIMP_TPU_FOLD_CACHE", str(tmp_path))
+        segs = _segments(n_per=500)
+        tm = timing.from_dict(BASE)
+        ph1, _ = anchored.fold_segments(tm, segs, delta_fold=1)
+        assert list(tmp_path.glob("*.npz"))
+        deltafold.clear_cache()  # simulate a fresh process
+        ph2, _ = anchored.fold_segments(tm, segs, delta_fold=1)
+        assert deltafold.last_fold_info()["mode"] == "cache"
+        for a, b in zip(ph1, ph2):
+            assert np.array_equal(a, b)
+        # and a refold works off the disk-loaded product too
+        tm_new = timing.from_dict({**BASE, "F0": BASE["F0"] + 1e-10})
+        anchored.fold_segments(tm_new, segs, delta_fold=1)
+        assert deltafold.last_fold_info()["mode"] == "delta"
+
+
+class TestKnobOffBitwise:
+    def test_off_path_matches_pre_engine_fold(self):
+        """delta_fold=0 must produce exactly the pre-engine computation:
+        prepare_anchors + anchored_fold on the concatenated events."""
+        segs = _segments(n_per=500)
+        tm = timing.from_dict(BASE)
+        ph, t_ref = anchored.fold_segments(tm, segs, delta_fold=0)
+        sizes = [t.size for t in segs]
+        anchor_idx = np.repeat(np.arange(len(segs)), sizes)
+        delta = anchored.anchor_deltas(np.concatenate(segs), t_ref, anchor_idx)
+        am = anchored.prepare_anchors(tm, t_ref)
+        expect = np.asarray(anchored.anchored_fold(
+            am, jnp.asarray(delta), jnp.asarray(anchor_idx)))
+        assert np.array_equal(np.concatenate(ph), expect)
+
+    def test_default_resolution_is_off(self):
+        # autotune off + no env (the autouse fixture) -> engine off
+        assert deltafold.resolve(10_000) == {
+            "delta_fold": 0, "budget": autotune.DELTA_FOLD_BUDGET_DEFAULT}
+        segs = _segments(n_per=200)
+        ph_default, _ = anchored.fold_segments(timing.from_dict(BASE), segs)
+        ph_off, _ = anchored.fold_segments(timing.from_dict(BASE), segs,
+                                           delta_fold=0)
+        for a, b in zip(ph_default, ph_off):
+            assert np.array_equal(a, b)
+
+
+class TestShardedDeltaFold:
+    def test_sharded_refold_bitwise_matches_monolithic(self):
+        from crimp_tpu.parallel import mesh
+
+        assert len(jax.devices()) == 8  # the conftest virtual mesh
+        segs = _segments(n_per=501, n_seg=3)  # deliberately not 8-aligned
+        tm = timing.from_dict(BASE)
+        ph, t_ref = anchored.fold_segments(tm, segs, delta_fold=0)
+        folded = np.concatenate(ph)
+        sizes = [t.size for t in segs]
+        anchor_idx = np.repeat(np.arange(len(segs)), sizes)
+        delta = anchored.anchor_deltas(np.concatenate(segs), t_ref, anchor_idx)
+        dp = np.zeros(deltafold.n_params(2))
+        dp[0] = 3e-10
+        dp[13] = 1e-3
+        dp[17] = 1e-9
+        fb = deltafold.build_basis(tm, t_ref, delta, anchor_idx)
+        mono = np.asarray(deltafold.refold(
+            jnp.asarray(folded), fb.b, jnp.asarray(dp)))
+        sharded = mesh.delta_refold_sharded(
+            tm, t_ref, folded, delta, anchor_idx, dp)
+        assert sharded.shape == mono.shape
+        assert np.array_equal(sharded, mono)
+
+
+class TestFitUtilsDeltaPath:
+    CFG = {"delta_fold": 1, "budget": autotune.DELTA_FOLD_BUDGET_DEFAULT}
+
+    def _parfile(self):
+        flags1 = {"F0", "F1", "GLF0_1", "GLPH_1"}
+        par = {}
+        for k, v in BASE.items():
+            par[k] = {"value": v, "flag": int(k in flags1)}
+        return par
+
+    def test_matches_exact_residual_model(self):
+        from crimp_tpu.pipelines import fit_utils
+
+        par = self._parfile()
+        keys = ["F0", "F1", "GLF0_1", "GLPH_1"]
+        pvec = np.array([3e-10, -2e-17, 5e-10, 1e-3])
+        t = np.linspace(58320.0, 58700.0, 400)
+        exact = fit_utils.model_phase_residuals(t, par, pvec, keys)
+        fast = fit_utils.model_phase_residuals_delta(t, par, pvec, keys,
+                                                     cfg=self.CFG)
+        assert fast is not None
+        np.testing.assert_allclose(fast, exact, atol=1e-9)
+
+    def test_matches_exact_with_frozen_waves(self):
+        from crimp_tpu.pipelines import fit_utils
+
+        par = self._parfile()
+        par["WAVEEPOCH"] = {"value": 58360.0, "flag": 0}
+        par["WAVE_OM"] = {"value": 0.0075, "flag": 0}
+        par["WAVE1"] = {"value": {"A": 2e-3, "B": -1e-3}}
+        keys = ["F0", "GLF0_1"]
+        pvec = np.array([2e-10, -4e-10])
+        t = np.linspace(58320.0, 58700.0, 300)
+        exact = fit_utils.model_phase_residuals(t, par, pvec, keys)
+        fast = fit_utils.model_phase_residuals_delta(t, par, pvec, keys,
+                                                     cfg=self.CFG)
+        assert fast is not None
+        np.testing.assert_allclose(fast, exact, atol=1e-9)
+
+    def test_declines_nonlinear_or_wave_keys(self):
+        from crimp_tpu.pipelines import fit_utils
+
+        par = self._parfile()
+        t = np.linspace(58320.0, 58700.0, 50)
+        for keys, pvec in (
+            (["GLEP_1"], np.array([0.5])),
+            (["GLTD_1"], np.array([1.0])),
+            (["F0", "WAVE1_A"], np.array([1e-10, 1e-3])),
+            (["F13"], np.array([1e-30])),
+        ):
+            assert fit_utils.model_phase_residuals_delta(
+                t, dict(par), pvec, keys, cfg=self.CFG) is None
+
+    def test_knob_off_returns_none(self):
+        from crimp_tpu.pipelines import fit_utils
+
+        par = self._parfile()
+        t = np.linspace(58320.0, 58700.0, 50)
+        out = fit_utils.model_phase_residuals_delta(
+            t, par, np.array([1e-10]), ["F0"],
+            cfg={"delta_fold": 0, "budget": 1e-9})
+        assert out is None
+
+    def test_budget_exceeded_returns_none(self):
+        from crimp_tpu.pipelines import fit_utils
+
+        par = self._parfile()
+        t = np.linspace(58320.0, 58700.0, 50)
+        out = fit_utils.model_phase_residuals_delta(
+            t, par, np.array([1e-10]), ["F0"],
+            cfg={"delta_fold": 1, "budget": 1e-30})
+        assert out is None
+
+
+class TestWindowBasisMatmul:
+    def test_window_log_prob_uses_rank2_taylor_basis(self):
+        """The local-ephemeris window model mu = basis @ theta must equal
+        the explicit d0*dt + d1*dt^2/2 formula it replaced."""
+        from crimp_tpu.pipelines.local_ephem import _window_log_prob
+
+        rng = np.random.default_rng(5)
+        dt = np.sort(rng.uniform(-4e6, 4e6, 64))
+        theta = np.array([2.4e-9, -1.1e-16])
+        basis = deltafold.taylor_basis_seconds(dt, 2)
+        mask = np.ones_like(dt)
+        y = rng.normal(0, 1e-3, dt.size)
+        err = np.full(dt.size, 1e-3)
+        data = {
+            "basis": jnp.asarray(basis), "y": jnp.asarray(y),
+            "err": jnp.asarray(err), "mask": jnp.asarray(mask),
+            "lo": jnp.asarray([-1e-6, -1e-12]), "hi": jnp.asarray([1e-6, 1e-12]),
+        }
+        lp = float(_window_log_prob(jnp.asarray(theta), data))
+        mu = theta[0] * dt + 0.5 * theta[1] * dt**2
+        mu = mu - mu.mean()
+        resid = (y - mu) / err
+        expect = -0.5 * np.sum(resid**2 + np.log(2 * np.pi * err**2))
+        assert lp == pytest.approx(expect, rel=1e-12)

@@ -342,3 +342,86 @@ class TestResumableGridMXU:
         with pytest.raises(ValueError, match="fingerprint mismatch"):
             ResumableScan(events, freqs, nharm=2, store=str(store),
                           chunk_trials=200)
+
+
+class TestResumableDeltaFold:
+    """The delta-fold engine choice is numeric mode too: a store written by
+    a session that refolds via cached fold products must not silently feed
+    a session pinned to exact re-anchoring (and vice versa)."""
+
+    def test_env_pins_delta_fold_mode(self, events, tmp_path, monkeypatch):
+        import json
+
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD", "1")
+        scan = ResumableScan(events, freqs, nharm=2, store=str(store),
+                             chunk_trials=200)
+        assert scan._delta_fold
+        scan.run()
+        fp = json.loads((store / "manifest.json").read_text())
+        assert fp["numeric_mode"]["delta_fold"][0] == 1
+        assert fp["numeric_mode"]["delta_fold"][1] > 0.0
+
+    def test_store_adopts_pinned_delta_fold(self, events, tmp_path,
+                                            monkeypatch):
+        """A preference drift between sessions adopts the store's pinned
+        engine mode and budget; the resumed statistic is identical."""
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD", "1")
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD_BUDGET", "5e-10")
+        first = ResumableScan(events, freqs, nharm=2, store=str(store),
+                              chunk_trials=200)
+        power = first.run()
+        sorted(store.glob("chunk_*.npy"))[0].unlink()
+        monkeypatch.delenv("CRIMP_TPU_DELTA_FOLD", raising=False)
+        monkeypatch.delenv("CRIMP_TPU_DELTA_FOLD_BUDGET", raising=False)
+        resumed = ResumableScan(events, freqs, nharm=2, store=str(store),
+                                chunk_trials=200)
+        assert resumed._delta_fold  # adopted from the store, not re-resolved
+        assert resumed._delta_fold_budget == 5e-10
+        np.testing.assert_array_equal(resumed.run(), power)
+
+    def test_explicit_env_conflict_refuses(self, events, tmp_path,
+                                           monkeypatch):
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD", "1")
+        ResumableScan(events, freqs, nharm=2, store=str(store),
+                      chunk_trials=200).run()
+        # an EXPLICIT =0 against a delta-fold store is a hand-pinned
+        # conflict, not a preference drift
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD", "0")
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, store=str(store),
+                          chunk_trials=200)
+
+    def test_legacy_store_without_delta_fold_key_adopts_off(
+            self, events, tmp_path, monkeypatch):
+        """Pre-engine stores carry no delta_fold entry: resume adopts the
+        exact fold at the default budget (what those chunks were computed
+        with) instead of refusing or KeyErroring."""
+        import json
+
+        from crimp_tpu.ops import autotune
+
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.delenv("CRIMP_TPU_DELTA_FOLD", raising=False)
+        ResumableScan(events, freqs, nharm=2, store=str(store),
+                      chunk_trials=200).run()
+        manifest = store / "manifest.json"
+        fp = json.loads(manifest.read_text())
+        del fp["numeric_mode"]["delta_fold"]
+        manifest.write_text(json.dumps(fp))
+        resumed = ResumableScan(events, freqs, nharm=2, store=str(store),
+                                chunk_trials=200)
+        assert not resumed._delta_fold
+        assert resumed._delta_fold_budget == autotune.DELTA_FOLD_BUDGET_DEFAULT
+        # an EXPLICIT =1 against the legacy exact store is a hand-pinned
+        # conflict, same as against a fresh exact store
+        monkeypatch.setenv("CRIMP_TPU_DELTA_FOLD", "1")
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, store=str(store),
+                          chunk_trials=200)

@@ -509,3 +509,138 @@ class TestAOTWarmup:
         jax.jit(lambda x: x * 2 + 1)(jnp.arange(7.0)).block_until_ready()
         after = profiling.compile_counters()
         assert after["cache_hits"] + after["cache_misses"] >= 1
+
+
+class TestSessionShellGuards:
+    """Deadline/backoff policy of the on-chip session shell tooling, pinned
+    off-chip: the relay interpreter is stubbed out via PATH so each guard's
+    decision (probe or abandon, run or replay, probe or suppress) is
+    observable as stub invocation counts plus the session log."""
+
+    @staticmethod
+    def _stub(tmp_path, name, body):
+        stub_dir = tmp_path / "bin"
+        stub_dir.mkdir(exist_ok=True)
+        path = stub_dir / name
+        path.write_text("#!/bin/sh\n" + body)
+        path.chmod(0o755)
+        return stub_dir
+
+    @staticmethod
+    def _run(script, out, env_extra, tmp_path, stub_dir=None, timeout=120):
+        import os
+        import pathlib
+        import subprocess
+        import sys
+
+        repo = pathlib.Path(__file__).parent.parent
+        env = dict(os.environ)
+        if stub_dir is not None:
+            env["PATH"] = str(stub_dir) + os.pathsep + env["PATH"]
+        env.update(env_extra)
+        return subprocess.run(
+            ["bash", str(repo / "scripts" / script), str(out)],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=str(repo),
+        )
+
+    def test_onchip_entry_deadline_skips_even_first_probe(self, tmp_path):
+        """ADVICE r5: with the deadline closer than one probe timeout
+        (300 s), ensure_healthy must abandon BEFORE the entry probe — a
+        wedged probe is chip-holding time the deadline promised away."""
+        import time
+
+        cnt = tmp_path / "python_calls"
+        stub_dir = self._stub(tmp_path, "python",
+                              f'echo x >> "{cnt}"\nexit 1\n')
+        out = tmp_path / "out"
+        proc = self._run(
+            "onchip_session.sh", out,
+            {"CRIMP_TPU_SESSION_DEADLINE": str(int(time.time()) - 10)},
+            tmp_path, stub_dir=stub_dir)
+        assert proc.returncode == 1
+        assert not cnt.exists(), cnt.read_text()  # ZERO interpreter launches
+        log = (out / "session.log").read_text()
+        assert "abandoning relay recovery: even one probe" in log
+        assert '{"stage": "health", "rc": 1}' in \
+            (out / "results.jsonl").read_text()
+
+    def test_onchip_loop_deadline_abandons_without_sleeping(self, tmp_path):
+        """With ~400 s to the deadline the entry probe may run (it fits),
+        but after it fails the recovery loop must abandon instead of
+        starting a 600 s sleep+probe round."""
+        import time
+
+        cnt = tmp_path / "python_calls"
+        stub_dir = self._stub(tmp_path, "python",
+                              f'echo x >> "{cnt}"\nexit 1\n')
+        out = tmp_path / "out"
+        t0 = time.monotonic()
+        proc = self._run(
+            "onchip_session.sh", out,
+            {"CRIMP_TPU_SESSION_DEADLINE": str(int(time.time()) + 400)},
+            tmp_path, stub_dir=stub_dir)
+        assert proc.returncode == 1
+        assert time.monotonic() - t0 < 60  # no sleep-300 round started
+        assert cnt.read_text() == "x\n"  # exactly the one entry probe
+        log = (out / "session.log").read_text()
+        assert "relay unhealthy at" in log
+        assert "next probe round would overrun session deadline" in log
+
+    def test_late_window_replays_full_session_done_markers(self, tmp_path):
+        """A late session relaunched into an outdir where the FULL session
+        already greened every stage must replay all three as cached (zero
+        chip time) and still run extract_rates on the recorded artifacts."""
+        cnt = tmp_path / "python_calls"
+        stub_dir = self._stub(tmp_path, "python",
+                              f'echo "$1" >> "{cnt}"\nexit 0\n')
+        out = tmp_path / "out"
+        out.mkdir()
+        # bench was greened by the FULL session (done_bench), the other two
+        # by a previous late attempt (done_late_*)
+        (out / "done_bench").touch()
+        (out / "done_late_config5").touch()
+        (out / "done_late_round_guard").touch()
+        (out / "bench.log").write_text("recorded by the full session\n")
+        proc = self._run("late_window_session.sh", out, {}, tmp_path,
+                         stub_dir=stub_dir)
+        assert proc.returncode == 0
+        results = (out / "results_late.jsonl").read_text()
+        assert results.count('"cached": true') == 3
+        assert '"rc": -' not in results  # nothing skipped or failed
+        # the ONLY interpreter launch is extract_rates over the artifacts
+        assert cnt.read_text().strip().endswith("extract_rates.py")
+        assert len(cnt.read_text().splitlines()) == 1
+        # no stage ran, so the full session's bench record was not clobbered
+        assert not (out / "bench_late.log").exists()
+        assert (out / "bench.log").read_text() == \
+            "recorded by the full session\n"
+
+    def test_watch_relay_suppresses_probes_after_timeout_kill(self, tmp_path):
+        """ADVICE r5: after a fallback jax probe is timeout-KILLED (rc 124
+        == wedged relay, and the kill may have refreshed the stale grant),
+        the watcher must suppress further probes for the backoff window
+        instead of re-wedging the grant every 10th tick."""
+        cnt = tmp_path / "timeout_calls"
+        stub_dir = self._stub(tmp_path, "timeout",
+                              f'echo x >> "{cnt}"\nexit 124\n')
+        import os
+        import pathlib
+        import subprocess
+
+        repo = pathlib.Path(__file__).parent.parent
+        out = tmp_path / "out"
+        env = dict(os.environ)
+        env["PATH"] = str(stub_dir) + os.pathsep + env["PATH"]
+        env["CRIMP_TPU_RELAY_PORT"] = "1"  # nothing listens there
+        proc = subprocess.run(
+            ["bash", str(repo / "scripts" / "watch_relay.sh"), str(out),
+             "1", "0.003"],  # period 1 s, ~11 s window => >=2 probe ticks
+            capture_output=True, text=True, timeout=90, env=env,
+            cwd=str(repo))
+        assert proc.returncode == 1  # gave up at the deadline, chip free
+        assert "gave up" in proc.stdout
+        # tick 0 probed and was killed; tick 10 fell inside the backoff
+        # window, so exactly ONE probe ran in the whole watch
+        assert cnt.read_text() == "x\n"
+        assert proc.stdout.count("suppressing probes") == 1
