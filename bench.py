@@ -106,13 +106,15 @@ def choose_platform(probe_timeout_s: float = 300.0) -> str:
     """
     import os
 
-    forced = os.environ.get("CRIMP_TPU_BENCH_PLATFORM", "").strip()
+    from crimp_tpu import knobs
+
+    forced = knobs.env_str("CRIMP_TPU_BENCH_PLATFORM")
     if forced:
         return forced
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         return "cpu"
-    deadline_s = float(os.environ.get("CRIMP_TPU_BENCH_PROBE_DEADLINE_S", "2400"))
-    port = int(os.environ.get("CRIMP_TPU_RELAY_PORT", "8113"))
+    deadline_s = knobs.env_float("CRIMP_TPU_BENCH_PROBE_DEADLINE_S", 2400.0)
+    port = knobs.env_int("CRIMP_TPU_RELAY_PORT", 8113)
     probe = "import jax; print(jax.devices()[0].platform)"
     deadline = time.monotonic() + deadline_s
     attempt = 0
@@ -815,9 +817,9 @@ def emit_partial(name: str, payload: dict) -> None:
     completes — a later stage wedging the process must not erase earlier
     measurements (VERDICT r4 #8). Best-effort: the sidecar failing must
     never take down the bench."""
-    import os
+    from crimp_tpu import knobs
 
-    path = os.environ.get("CRIMP_TPU_BENCH_PARTIAL", "").strip()
+    path = knobs.env_str("CRIMP_TPU_BENCH_PARTIAL")
     if not path:
         return
     try:
@@ -831,13 +833,14 @@ def emit_partial(name: str, payload: dict) -> None:
 
 
 def main():
-    import os
     import pathlib
     import traceback
 
     # fresh sidecar per run: stale rows from an earlier attempt in the same
     # outdir must never be stitched into this run's reconstruction
-    sidecar = os.environ.get("CRIMP_TPU_BENCH_PARTIAL", "").strip()
+    from crimp_tpu import knobs
+
+    sidecar = knobs.env_str("CRIMP_TPU_BENCH_PARTIAL")
     if sidecar:
         try:
             open(sidecar, "w").close()
@@ -881,7 +884,7 @@ def main():
     # CRIMP_TPU_BENCH_SCALE < 1 shrinks every workload (with floors that
     # keep each stage meaningful) so the end-to-end time-envelope test can
     # drive the full worst-case path inside a simulated driver budget.
-    scale = float(os.environ.get("CRIMP_TPU_BENCH_SCALE", "1.0"))
+    scale = knobs.env_float("CRIMP_TPU_BENCH_SCALE", 1.0)
 
     def scaled(base: int, floor: int) -> int:
         return max(int(base * scale), floor)

@@ -1,0 +1,26 @@
+"""graftlint — crimp_tpu's trace-discipline / knob-registry / parity
+static analyzer.
+
+Usage::
+
+    python -m crimp_tpu.analysis [--format json|text] [paths...]
+    bash scripts/lint.sh
+
+Rules (docs/analysis.md has the full contract + waiver syntax):
+
+- GL001 trace purity (env/time/random/file-I/O unreachable from traced code)
+- GL002 host-sync hazards (tracer coercions / branching)
+- GL003 knob-registry consistency (crimp_tpu/knobs.py <-> reads <-> docs
+  <-> resumable numeric_mode fingerprint)
+- GL004 dtype discipline (longdouble confined to host-side anchor modules)
+- GL005 order-sensitive reductions in sharded/parity-pinned modules
+
+The tier-1 gate (tests/test_analysis.py) runs the full rule set over
+crimp_tpu/, scripts/ and bench.py and requires zero unwaived findings.
+"""
+
+from crimp_tpu.analysis.cli import main
+from crimp_tpu.analysis.core import RULES, Config, Finding, Report
+from crimp_tpu.analysis.engine import run
+
+__all__ = ["main", "run", "Config", "Finding", "Report", "RULES"]

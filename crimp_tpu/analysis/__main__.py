@@ -1,0 +1,3 @@
+from crimp_tpu.analysis.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,429 @@
+"""graftlint call graph: which functions run under a JAX trace?
+
+Builds a project-wide, name-resolved call graph from plain ASTs and
+computes the set of functions reachable from *trace entry points* —
+functions handed to ``jax.jit``/``pjit``/``vmap``/``pmap``/``shard_map``/
+``pallas_call``/``checkpoint``/``remat`` (as decorators, ``partial``
+decorators, or call-site wrappers) and the body/branch callables of
+``lax.scan``/``while_loop``/``fori_loop``/``cond``/``switch``.
+
+Resolution is deliberately name-based and conservative:
+
+- ``Name`` callees resolve through the lexical scope chain (nested defs,
+  enclosing class, module level), then ``from x import y`` aliases;
+- ``mod.f`` attribute callees resolve when ``mod`` is an import alias of
+  a module inside the scan set;
+- ``self.m`` resolves to methods of the lexically enclosing class.
+
+Anything unresolvable (external libraries, dynamic dispatch) simply adds
+no edge — the rules that consume the graph (GL001/GL002) look at call
+*sites* inside traced bodies for the banned host operations, so an
+unresolved edge can hide a transitive violation but never invent one.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+
+# wrapper name -> positions of the traced callable argument(s).
+# Unambiguous jax-only names accept bare-Name or any-attribute forms;
+# AMBIGUOUS_TAILS additionally require a lax-ish qualifier (``jax.lax.scan``,
+# ``lax.scan``) or a recorded ``from jax.lax import scan``.
+TRACE_WRAPPERS: dict[str, tuple[int, ...]] = {
+    "jit": (0,),
+    "pjit": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "shard_map": (0,),
+    "pallas_call": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2, 3),
+    "switch": (1,),
+}
+AMBIGUOUS_TAILS = {"scan", "while_loop", "fori_loop", "cond", "switch"}
+
+# Parameter annotations / default types treated as static configuration
+# (never tracers) by the GL002 heuristics.
+STATIC_ANNOTATIONS = {"int", "bool", "str", "float"}
+
+
+def dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for nested Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_tail(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    module: str  # root-relative posix path
+    qualname: str  # e.g. "Class.method" / "outer.<locals>.inner"
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    name: str
+    lineno: int
+    params: tuple[str, ...]
+    static_params: frozenset[str]  # annotation/default-typed config params
+    class_name: str | None = None
+    entry_reason: str | None = None  # set when this is a trace entry point
+    traced_via: str | None = None  # entry (or caller) that makes it traced
+
+    @property
+    def label(self) -> str:
+        return f"{self.module}:{self.qualname}"
+
+
+def _param_info(node: ast.AST) -> tuple[tuple[str, ...], frozenset[str]]:
+    """(param names, statically-typed param names) for a def/lambda."""
+    if isinstance(node, ast.Lambda):
+        a = node.args
+    else:
+        a = node.args
+    args = [*a.posonlyargs, *a.args, *a.kwonlyargs]
+    names = tuple(arg.arg for arg in args)
+    static: set[str] = set(arg.arg for arg in a.kwonlyargs)
+    for arg in args:
+        ann = arg.annotation
+        if ann is not None:
+            text = dotted(ann) or (ann.value if isinstance(ann, ast.Constant)
+                                   and isinstance(ann.value, str) else "")
+            base = str(text).split("|")[0].strip().split(".")[-1]
+            if base in STATIC_ANNOTATIONS:
+                static.add(arg.arg)
+    defaults = list(a.defaults)
+    if defaults and not isinstance(node, ast.Lambda):
+        for arg, dflt in zip(args[len(args) - len(a.kwonlyargs) - len(defaults):],
+                             defaults):
+            if isinstance(dflt, ast.Constant) and isinstance(
+                    dflt.value, (bool, int, str, type(None))):
+                static.add(arg.arg)
+    for arg, dflt in zip(a.kwonlyargs, a.kw_defaults):
+        if isinstance(dflt, ast.Constant):
+            static.add(arg.arg)
+    return names, frozenset(static)
+
+
+class ModuleIndex:
+    def __init__(self, rel: str, tree: ast.Module):
+        self.rel = rel
+        self.tree = tree
+        self.functions: dict[str, FunctionInfo] = {}
+        # import alias -> dotted module name ("search" -> "crimp_tpu.ops.search")
+        self.module_aliases: dict[str, str] = {}
+        # from-import: local name -> (dotted module, original name)
+        self.from_imports: dict[str, tuple[str, str]] = {}
+        self._index()
+
+    def _index(self) -> None:
+        mod = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self) -> None:
+                self.stack: list[tuple[str, str]] = []  # (kind, name)
+
+            def _qual(self, name: str) -> str:
+                parts = [n for _, n in self.stack] + [name]
+                return ".".join(parts)
+
+            def visit_Import(self, node: ast.Import) -> None:
+                for alias in node.names:
+                    mod.module_aliases[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0])
+
+            def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+                if node.module is None or node.level:
+                    return
+                for alias in node.names:
+                    mod.from_imports[alias.asname or alias.name] = (
+                        node.module, alias.name)
+                    # ``from crimp_tpu.parallel import mesh`` binds a module
+                    mod.module_aliases.setdefault(
+                        alias.asname or alias.name,
+                        f"{node.module}.{alias.name}")
+
+            def _def(self, node) -> None:
+                params, static = _param_info(node)
+                cls = self.stack[-1][1] if self.stack and self.stack[-1][0] == "class" else None
+                qual = self._qual(node.name)
+                mod.functions[qual] = FunctionInfo(
+                    module=mod.rel, qualname=qual, node=node, name=node.name,
+                    lineno=node.lineno, params=params, static_params=static,
+                    class_name=cls)
+                self.stack.append(("func", node.name))
+                self.generic_visit(node)
+                self.stack.pop()
+
+            visit_FunctionDef = _def
+            visit_AsyncFunctionDef = _def
+
+            def visit_ClassDef(self, node: ast.ClassDef) -> None:
+                self.stack.append(("class", node.name))
+                self.generic_visit(node)
+                self.stack.pop()
+
+        V().visit(self.tree)
+
+    def lambda_info(self, node: ast.Lambda) -> FunctionInfo:
+        qual = f"<lambda@{node.lineno}>"
+        if qual not in self.functions:
+            params, static = _param_info(node)
+            self.functions[qual] = FunctionInfo(
+                module=self.rel, qualname=qual, node=node, name=qual,
+                lineno=node.lineno, params=params, static_params=static)
+        return self.functions[qual]
+
+
+def _module_dotted_name(rel: str) -> str:
+    p = pathlib.PurePosixPath(rel)
+    parts = list(p.with_suffix("").parts)
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class Project:
+    """All scanned modules + the traced-reachability closure."""
+
+    def __init__(self, sources: dict[str, ast.Module]):
+        self.modules: dict[str, ModuleIndex] = {
+            rel: ModuleIndex(rel, tree) for rel, tree in sources.items()}
+        self.by_dotted: dict[str, ModuleIndex] = {
+            _module_dotted_name(rel): m for rel, m in self.modules.items()}
+        self._traced: dict[str, FunctionInfo] | None = None
+
+    # -- name resolution ----------------------------------------------------
+
+    def _resolve_in_module(self, mod: ModuleIndex, scope: str | None,
+                           name: str) -> FunctionInfo | None:
+        # lexical chain: nested defs of the scope, enclosing scopes, module
+        prefixes: list[str] = []
+        if scope:
+            parts = scope.split(".")
+            prefixes = [".".join(parts[:i]) for i in range(len(parts), 0, -1)]
+        for prefix in prefixes:
+            hit = mod.functions.get(f"{prefix}.{name}")
+            if hit is not None:
+                return hit
+        hit = mod.functions.get(name)
+        if hit is not None:
+            return hit
+        imp = mod.from_imports.get(name)
+        if imp is not None:
+            target_mod = self.by_dotted.get(imp[0])
+            if target_mod is not None:
+                return target_mod.functions.get(imp[1])
+        return None
+
+    def resolve_callable(self, mod: ModuleIndex, scope: str | None,
+                         node: ast.AST) -> FunctionInfo | None:
+        """Resolve a callable-valued expression to a scanned function."""
+        # partial(f, ...) and functools.partial(f, ...): unwrap
+        if isinstance(node, ast.Call) and call_tail(node.func) == "partial" and node.args:
+            return self.resolve_callable(mod, scope, node.args[0])
+        if isinstance(node, ast.Lambda):
+            return mod.lambda_info(node)
+        if isinstance(node, ast.Name):
+            return self._resolve_in_module(mod, scope, node.id)
+        if isinstance(node, ast.Attribute):
+            path = dotted(node)
+            if path is None:
+                return None
+            head, _, rest = path.partition(".")
+            if head == "self" and scope:
+                # method on the lexically enclosing class
+                cls_prefix = scope.split(".")[0]
+                return mod.functions.get(f"{cls_prefix}.{rest}")
+            target = mod.module_aliases.get(head)
+            if target is not None:
+                target_mod = self.by_dotted.get(target)
+                if target_mod is None and "." in path:
+                    # ``import crimp_tpu.ops.search as s`` style full path
+                    target_mod = self.by_dotted.get(
+                        ".".join([target] + rest.split(".")[:-1]))
+                    rest = rest.split(".")[-1]
+                if target_mod is not None:
+                    return target_mod.functions.get(rest)
+        return None
+
+    # -- trace entries ------------------------------------------------------
+
+    def _is_wrapper_call(self, mod: ModuleIndex, node: ast.Call) -> str | None:
+        tail = call_tail(node.func)
+        if tail not in TRACE_WRAPPERS:
+            return None
+        if tail in AMBIGUOUS_TAILS:
+            path = dotted(node.func) or ""
+            parts = path.split(".")
+            qualified = len(parts) > 1 and parts[-2] in ("lax", "pl", "pallas")
+            imported = mod.from_imports.get(tail, ("", ""))[0].endswith("lax")
+            if not (qualified or imported):
+                return None
+        return tail
+
+    def _entry_points(self) -> list[tuple[FunctionInfo, str]]:
+        entries: list[tuple[FunctionInfo, str]] = []
+        for mod in self.modules.values():
+            # decorator-based entries
+            for info in list(mod.functions.values()):
+                node = info.node
+                if isinstance(node, ast.Lambda):
+                    continue
+                for dec in node.decorator_list:
+                    reason = self._decorator_entry(mod, dec, info)
+                    if reason:
+                        entries.append((info, reason))
+                        self._absorb_static_argnames(dec, info)
+                        break
+            # call-site entries: jit(f), lax.scan(body, ...), vmap(f)...
+            scope_stack: list[str] = []
+            project = self
+
+            class W(ast.NodeVisitor):
+                def _scoped(self, node):
+                    scope_stack.append(node.name if hasattr(node, "name")
+                                       else f"<lambda@{node.lineno}>")
+                    self.generic_visit(node)
+                    scope_stack.pop()
+
+                visit_FunctionDef = _scoped
+                visit_AsyncFunctionDef = _scoped
+
+                def visit_ClassDef(self, node):
+                    self._scoped(node)
+
+                def visit_Call(self, node: ast.Call):
+                    tail = project._is_wrapper_call(mod, node)
+                    if tail is not None:
+                        scope = ".".join(scope_stack) or None
+                        for pos in TRACE_WRAPPERS[tail]:
+                            if pos >= len(node.args):
+                                continue
+                            arg = node.args[pos]
+                            cands = (arg.elts if isinstance(
+                                arg, (ast.List, ast.Tuple)) else [arg])
+                            for cand in cands:
+                                info = project.resolve_callable(mod, scope, cand)
+                                if info is not None:
+                                    entries.append((
+                                        info, f"passed to {tail}() at "
+                                              f"{mod.rel}:{node.lineno}"))
+                                    project._absorb_static_argnames(node, info)
+                    self.generic_visit(node)
+
+            W().visit(mod.tree)
+        return entries
+
+    def _decorator_entry(self, mod: ModuleIndex, dec: ast.AST,
+                         info: FunctionInfo) -> str | None:
+        tail = call_tail(dec)
+        if tail in TRACE_WRAPPERS and tail not in AMBIGUOUS_TAILS:
+            return f"@{tail}"
+        if isinstance(dec, ast.Call):
+            ctail = call_tail(dec.func)
+            if ctail in TRACE_WRAPPERS and ctail not in AMBIGUOUS_TAILS:
+                return f"@{ctail}(...)"
+            if ctail == "partial" and dec.args:
+                inner = call_tail(dec.args[0])
+                if inner in TRACE_WRAPPERS and inner not in AMBIGUOUS_TAILS:
+                    return f"@partial({inner}, ...)"
+        return None
+
+    def _absorb_static_argnames(self, call: ast.AST, info: FunctionInfo) -> None:
+        """Fold jit static_argnames/static_argnums literals into the
+        function's static-parameter set (GL002 must not flag branching on
+        a static argument)."""
+        if not isinstance(call, ast.Call):
+            return
+        static = set(info.static_params)
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                        static.add(el.value)
+            elif kw.arg == "static_argnums":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                        if 0 <= el.value < len(info.params):
+                            static.add(info.params[el.value])
+        info.static_params = frozenset(static)
+
+    # -- reachability --------------------------------------------------------
+
+    def _callees(self, info: FunctionInfo) -> list[FunctionInfo]:
+        mod = self.modules[info.module]
+        scope = info.qualname if not info.qualname.startswith("<lambda") else None
+        out: list[FunctionInfo] = []
+        for node in iter_body_nodes(info.node):
+            if isinstance(node, ast.Call):
+                target = self.resolve_callable(mod, scope, node.func)
+                if target is not None:
+                    out.append(target)
+                # callables passed onward (e.g. body funcs) also traced
+                tail = self._is_wrapper_call(mod, node)
+                if tail is not None:
+                    for pos in TRACE_WRAPPERS[tail]:
+                        if pos < len(node.args):
+                            t = self.resolve_callable(mod, scope, node.args[pos])
+                            if t is not None:
+                                out.append(t)
+        return out
+
+    def traced_functions(self) -> dict[str, FunctionInfo]:
+        """label -> FunctionInfo for every function reachable from a trace
+        entry point (the entry points included)."""
+        if self._traced is not None:
+            return self._traced
+        traced: dict[str, FunctionInfo] = {}
+        queue: list[FunctionInfo] = []
+        for info, reason in self._entry_points():
+            if info.label not in traced:
+                info.entry_reason = reason
+                info.traced_via = f"entry: {reason}"
+                traced[info.label] = info
+                queue.append(info)
+        while queue:
+            cur = queue.pop()
+            for callee in self._callees(cur):
+                if callee.label not in traced:
+                    callee.traced_via = f"called from {cur.label}"
+                    traced[callee.label] = callee
+                    queue.append(callee)
+        self._traced = traced
+        return traced
+
+
+def iter_body_nodes(func_node: ast.AST):
+    """Walk a function body WITHOUT descending into nested function /
+    lambda definitions (those are separate FunctionInfos — a nested def
+    only matters if it is itself traced-reachable)."""
+    if isinstance(func_node, ast.Lambda):
+        roots = [func_node.body]
+    else:
+        roots = list(func_node.body)
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)

@@ -1,0 +1,100 @@
+"""graftlint CLI: ``python -m crimp_tpu.analysis [paths...]``.
+
+Exit codes: 0 = clean (or nothing new vs --baseline), 1 = unwaived
+findings, 2 = usage / I-O error. ``--write-baseline`` records today's
+unwaived findings so future runs with ``--baseline`` fail only on NEW
+findings (ratchet mode for incremental adoption).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from crimp_tpu.analysis import engine
+from crimp_tpu.analysis.core import (
+    RULES,
+    Config,
+    load_baseline,
+    new_findings,
+    save_baseline,
+)
+
+DEFAULT_PATHS = ("crimp_tpu", "scripts", "bench.py")
+
+
+def find_root(start: pathlib.Path) -> pathlib.Path:
+    """Nearest ancestor carrying pyproject.toml (the repo root the GL003
+    cross-checks are anchored to), else the start directory."""
+    for cand in [start, *start.parents]:
+        if (cand / "pyproject.toml").exists():
+            return cand
+    return start
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m crimp_tpu.analysis",
+        description="graftlint: trace-discipline, knob-registry and "
+                    "parity-invariant static analyzer for crimp_tpu.")
+    p.add_argument("paths", nargs="*", help="files/directories to scan "
+                   f"(default: {' '.join(DEFAULT_PATHS)} under the repo root)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--root", type=pathlib.Path, default=None,
+                   help="repo root (default: nearest ancestor with pyproject.toml)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule subset, e.g. GL001,GL003")
+    p.add_argument("--baseline", type=pathlib.Path, default=None,
+                   help="fail only on findings absent from this baseline file")
+    p.add_argument("--write-baseline", type=pathlib.Path, default=None,
+                   help="record current unwaived findings and exit 0")
+    p.add_argument("--show-waived", action="store_true",
+                   help="include waived findings in text output")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}  {desc}")
+        return 0
+    root = (args.root or find_root(pathlib.Path.cwd())).resolve()
+    raw_paths = args.paths or [p for p in DEFAULT_PATHS if (root / p).exists()]
+    cfg = Config(
+        root=root,
+        paths=[pathlib.Path(p) for p in raw_paths],
+        rules=tuple(r.strip() for r in args.rules.split(",")) if args.rules else None,
+    )
+    try:
+        report = engine.run(cfg)
+    except FileNotFoundError as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline is not None:
+        save_baseline(report, args.write_baseline)
+        print(f"graftlint: wrote baseline with {len(report.unwaived)} "
+              f"finding keys to {args.write_baseline}")
+        return 0
+
+    failing = report.unwaived
+    if args.baseline is not None:
+        try:
+            failing = new_findings(report, load_baseline(args.baseline))
+        except (OSError, ValueError) as exc:
+            print(f"graftlint: bad baseline: {exc}", file=sys.stderr)
+            return 2
+
+    if args.format == "json":
+        doc = report.to_dict()
+        doc["new_findings"] = [f.to_dict() for f in failing]
+        print(json.dumps(doc, indent=2))
+    else:
+        print(report.render_text(show_waived=args.show_waived))
+        if args.baseline is not None:
+            print(f"graftlint: {len(failing)} new vs baseline")
+    return 1 if failing else 0

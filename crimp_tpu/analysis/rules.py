@@ -1,0 +1,232 @@
+"""graftlint rules GL001/GL002/GL004/GL005 (GL003 lives in knobcheck.py).
+
+Each rule is a function ``(cfg, sources, project) -> list[Finding]``
+over the parsed scan set. The rules encode invariants the repo's kernel
+PRs established in prose (CHANGES.md, docs/parity.md) but nothing
+enforced mechanically:
+
+GL001  trace purity — no ``os.environ``/``time``/``random``/file-I/O
+       reachable from jit/pjit/shard_map/pallas_call/lax-control-flow
+       bodies. Knob resolution is host-side by contract ("no implicit
+       timing"), so calls into ``crimp_tpu.knobs`` or the
+       ``ops/autotune.py`` resolvers from traced code are violations too.
+GL002  host-sync hazards — ``float()``/``int()``/``bool()`` and
+       ``np.asarray``/``np.array`` applied to (non-static) parameters of
+       traced functions, ``.item()``/``.tolist()`` anywhere in traced
+       code, and Python ``if``/``while`` branching on a non-static
+       parameter of a trace entry point.
+GL004  dtype discipline — ``longdouble``/``float128`` confined to the
+       host-side anchor modules (the allowlist in core.DEFAULT_GL004_ALLOWLIST);
+       everywhere else the f64 device path is the contract.
+GL005  order-sensitive reductions — matmul/dot/einsum/axis-sums in the
+       sharded parity-pinned modules (crimp_tpu/parallel/) must carry a
+       waiver stating the fixed-order/parity argument (the PR-4 lesson:
+       XLA re-tiles matvec reductions per shape, so a sharded matvec
+       broke the 8-device bitwise pin).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from crimp_tpu.analysis.callgraph import (
+    FunctionInfo,
+    Project,
+    call_tail,
+    dotted,
+    iter_body_nodes,
+)
+from crimp_tpu.analysis.core import Config, Finding, SourceFile
+
+# -- GL001 -------------------------------------------------------------------
+
+TIME_FUNCS = {"time", "perf_counter", "perf_counter_ns", "monotonic",
+              "monotonic_ns", "sleep", "process_time", "thread_time"}
+FILE_IO_TAILS = {"read_text", "write_text", "read_bytes", "write_bytes"}
+# host-side knob/tuner resolution entry points (ops/autotune.py): calling
+# these from traced code would re-introduce implicit env reads/timing
+RESOLVER_PREFIXES = ("resolve_", "cached_", "autotune_mode", "tune",
+                     "sweep_candidates")
+
+
+def _gl001_banned(node: ast.AST, mod, project: Project,
+                  scope: str | None) -> str | None:
+    """A human message if this node is a banned host operation."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        if isinstance(node.value, ast.Name) and node.value.id == "os":
+            return "os.environ access"
+    if not isinstance(node, ast.Call):
+        return None
+    path = dotted(node.func) or ""
+    tail = call_tail(node.func)
+    if path == "os.getenv":
+        return "os.getenv() call"
+    head = path.split(".")[0] if path else ""
+    if head == "time" and tail in TIME_FUNCS:
+        return f"time.{tail}() call (no implicit timing in traced code)"
+    if head == "random":
+        return f"random.{tail}() call (host RNG in traced code)"
+    if isinstance(node.func, ast.Name) and node.func.id == "open":
+        return "open() call (file I/O in traced code)"
+    if tail in FILE_IO_TAILS:
+        return f".{tail}() call (file I/O in traced code)"
+    target = project.resolve_callable(mod, scope, node.func)
+    if target is not None:
+        if target.module.endswith("crimp_tpu/knobs.py") or target.module == "crimp_tpu/knobs.py":
+            return (f"knob accessor {target.name}() reached from traced code "
+                    "(knobs must resolve host-side)")
+        if (target.module.endswith("ops/autotune.py")
+                and target.name.startswith(RESOLVER_PREFIXES)):
+            return (f"autotune resolver {target.name}() reached from traced "
+                    "code (resolution is host-side by contract)")
+    return None
+
+
+def rule_gl001(cfg: Config, sources: dict[str, SourceFile],
+               project: Project) -> list[Finding]:
+    out: list[Finding] = []
+    for info in project.traced_functions().values():
+        mod = project.modules[info.module]
+        scope = info.qualname if not info.qualname.startswith("<lambda") else None
+        for node in iter_body_nodes(info.node):
+            msg = _gl001_banned(node, mod, project, scope)
+            if msg:
+                out.append(Finding(
+                    "GL001", info.module, getattr(node, "lineno", info.lineno),
+                    f"{msg} inside traced function {info.qualname!r} "
+                    f"({info.traced_via})"))
+    return out
+
+
+# -- GL002 -------------------------------------------------------------------
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _tracer_params(info: FunctionInfo) -> set[str]:
+    skip = set(info.static_params)
+    if info.class_name is not None:
+        skip.add("self")
+        skip.add("cls")
+    return set(info.params) - skip
+
+
+def _is_none_check(test: ast.AST) -> bool:
+    """``x is None`` / ``x is not None`` tests are static in a trace."""
+    return (isinstance(test, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops))
+
+
+def rule_gl002(cfg: Config, sources: dict[str, SourceFile],
+               project: Project) -> list[Finding]:
+    out: list[Finding] = []
+    for info in project.traced_functions().values():
+        tracers = _tracer_params(info)
+        for node in iter_body_nodes(info.node):
+            if isinstance(node, ast.Call):
+                tail = call_tail(node.func)
+                path = dotted(node.func) or ""
+                if tail in ("item", "tolist") and not node.args:
+                    out.append(Finding(
+                        "GL002", info.module, node.lineno,
+                        f".{tail}() in traced function {info.qualname!r} "
+                        "forces a device sync / concretization"))
+                    continue
+                coercer = None
+                if (isinstance(node.func, ast.Name)
+                        and node.func.id in ("float", "int", "bool")):
+                    coercer = node.func.id
+                elif path in ("np.asarray", "np.array", "numpy.asarray",
+                              "numpy.array", "np.float64", "np.float32"):
+                    coercer = path
+                if coercer and node.args:
+                    touched = _names_in(node.args[0]) & tracers
+                    if touched:
+                        out.append(Finding(
+                            "GL002", info.module, node.lineno,
+                            f"{coercer}() applied to parameter "
+                            f"{'/'.join(sorted(touched))} of traced function "
+                            f"{info.qualname!r} (concretizes a tracer)"))
+            elif (isinstance(node, (ast.If, ast.While))
+                  and info.entry_reason is not None
+                  and not _is_none_check(node.test)):
+                touched = _names_in(node.test) & tracers
+                if touched:
+                    out.append(Finding(
+                        "GL002", info.module, node.lineno,
+                        f"Python branch on parameter "
+                        f"{'/'.join(sorted(touched))} of trace entry "
+                        f"{info.qualname!r} ({info.entry_reason}); mark it "
+                        "static or use lax.cond/jnp.where"))
+    return out
+
+
+# -- GL004 -------------------------------------------------------------------
+
+EXTENDED_DTYPES = {"longdouble", "float128"}
+
+
+def rule_gl004(cfg: Config, sources: dict[str, SourceFile],
+               project: Project) -> list[Finding]:
+    out: list[Finding] = []
+    for rel, src in sources.items():
+        if not src.is_python or src.tree is None:
+            continue
+        if any(rel == a or rel.startswith(a) for a in cfg.gl004_allowlist):
+            continue
+        for node in ast.walk(src.tree):
+            name = None
+            if isinstance(node, ast.Attribute) and node.attr in EXTENDED_DTYPES:
+                name = dotted(node) or node.attr
+            elif isinstance(node, ast.Name) and node.id in EXTENDED_DTYPES:
+                name = node.id
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                modname = getattr(node, "module", None) or ""
+                if modname.split(".")[0] == "mpmath" or any(
+                        a.name.split(".")[0] == "mpmath" for a in node.names):
+                    name = "mpmath import"
+            if name:
+                out.append(Finding(
+                    "GL004", rel, node.lineno,
+                    f"{name} outside the host-side anchor allowlist "
+                    f"({', '.join(cfg.gl004_allowlist)}) — extended precision "
+                    "is confined so device kernels stay f64-reproducible"))
+    return out
+
+
+# -- GL005 -------------------------------------------------------------------
+
+ORDER_SENSITIVE_TAILS = {"dot", "matmul", "einsum", "tensordot", "inner",
+                         "vdot"}
+
+
+def rule_gl005(cfg: Config, sources: dict[str, SourceFile],
+               project: Project) -> list[Finding]:
+    out: list[Finding] = []
+    for rel, src in sources.items():
+        if not src.is_python or src.tree is None:
+            continue
+        if not any(rel == m or rel.startswith(m) for m in cfg.gl005_modules):
+            continue
+        for node in ast.walk(src.tree):
+            msg = None
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.MatMult):
+                msg = "matmul operator (@)"
+            elif isinstance(node, ast.Call):
+                tail = call_tail(node.func)
+                if tail in ORDER_SENSITIVE_TAILS:
+                    msg = f"{tail}()"
+                elif tail == "sum" and (node.args or any(
+                        k.arg == "axis" for k in node.keywords)):
+                    msg = "axis reduction sum()"
+            if msg:
+                out.append(Finding(
+                    "GL005", rel, node.lineno,
+                    f"{msg} in sharded/parity-pinned module — XLA re-tiles "
+                    "matvec/axis reductions per shape, which broke the "
+                    "8-device bitwise pin once (parallel/mesh.py); use "
+                    "fixed-order accumulation or waive with the parity "
+                    "argument"))
+    return out

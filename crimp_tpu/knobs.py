@@ -1,0 +1,289 @@
+"""Central registry of ``CRIMP_TPU_*`` environment knobs + parse helpers.
+
+Four PRs of kernel work accumulated a dozen-plus env knobs, each read at
+its call site with its own ad-hoc ``os.environ.get(...).strip().lower()``
+parsing. That scattering is exactly what the graftlint GL003 rule
+(crimp_tpu/analysis) now polices: every ``CRIMP_TPU_*`` read must go
+through this module, every knob must be declared here, every declared
+knob must carry a row in docs/tools.md, and every *numeric-affecting*
+knob must be pinned in the resumable store's ``numeric_mode`` fingerprint
+(ops/resumable.py) so chunks computed under different numeric modes can
+never silently mix.
+
+Registering a new knob (docs/analysis.md has the worked example):
+
+1. add a :class:`Knob` entry to ``REGISTRY`` below;
+2. add its row to the docs/tools.md environment-variable table (GL003
+   fails the tier-1 gate until you do);
+3. if ``numeric_key`` is set, make sure that key is pinned in
+   ``ResumableSearch._numeric_mode`` (GL003 checks this too);
+4. read it ONLY through the accessors here (``raw``/``env_onoff``/
+   ``env_nonneg_int``/...) — a direct ``os.environ`` read of a
+   ``CRIMP_TPU_*`` name anywhere else is a GL003 finding.
+
+The word sets below are the single definition of truthy/falsy strings so
+"1"/"on"/"true" handling is uniform across the library, bench.py and the
+scripts (the historical parsers disagreed about "none" and "never").
+Strict integer knobs (0/1 switches like CRIMP_TPU_GRID_MXU) deliberately
+do NOT accept the word forms: tests pin that "on"/"yes" raise there, so a
+typo'd numeric override can never silently pick a direction.
+
+Import-safe: this module must never import jax (the analyzer and the
+relay-window session scripts import it with no backend available).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+# The uniform truthy/falsy word sets. ON/OFF_WORDS are the historical
+# sets every boolean-ish knob already accepted; "none" stays a recognized
+# off-spelling only where a path knob needs it (env_path_or_off).
+ON_WORDS = frozenset(("1", "on", "true", "always"))
+OFF_WORDS = frozenset(("0", "off", "false", "never"))
+AUTO_WORDS = frozenset(("", "auto"))
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared ``CRIMP_TPU_*`` environment knob.
+
+    ``numeric_key`` names the entry of the resumable store's
+    ``numeric_mode`` fingerprint that pins this knob's resolved value
+    (None for knobs that cannot change computed bits — throughput,
+    caching, bench and session-orchestration knobs). GL003 enforces the
+    mapping in both directions.
+    """
+
+    name: str
+    default: str  # human-readable default, mirrored by the docs row
+    kind: str  # bool | enum | int | float | str | path | blocks
+    numeric_key: str | None = None
+    consumer: str = ""  # which layer reads it
+    doc: str = ""  # one-line effect summary
+
+    @property
+    def numeric(self) -> bool:
+        return self.numeric_key is not None
+
+
+def _build_registry(knobs: tuple[Knob, ...]) -> dict[str, Knob]:
+    out: dict[str, Knob] = {}
+    for k in knobs:
+        if not k.name.startswith("CRIMP_TPU_"):
+            raise ValueError(f"knob {k.name!r} outside the CRIMP_TPU_ namespace")
+        if k.name in out:
+            raise ValueError(f"duplicate knob registration {k.name!r}")
+        out[k.name] = k
+    return out
+
+
+REGISTRY: dict[str, Knob] = _build_registry((
+    # -- kernel numeric modes (pinned in resumable numeric_mode) ------------
+    Knob("CRIMP_TPU_POLY_TRIG", "auto (on for TPU backends)", "bool",
+         numeric_key="poly_trig", consumer="ops/fasttrig.py",
+         doc="polynomial sin/cos pair in the search kernels"),
+    Knob("CRIMP_TPU_GRID_FASTPATH", "auto (nharm-based)", "bool",
+         numeric_key="grid_fastpath", consumer="ops/search.py",
+         doc="f32 uniform-grid fast path vs exact-f64-phase kernel"),
+    Knob("CRIMP_TPU_GRID_BLOCKS", "unset (autotuner)", "blocks",
+         numeric_key="grid_blocks", consumer="ops/search.py via ops/autotune.py",
+         doc="hard (event_block, trial_block) override for the grid kernels"),
+    Knob("CRIMP_TPU_GRID_MXU", "unset (off unless a tuner winner)", "int",
+         numeric_key="grid_mxu", consumer="ops/search.py via ops/autotune.py",
+         doc="factorized angle-addition matmul grid kernels on/off"),
+    Knob("CRIMP_TPU_MXU_BF16", "unset (off unless a tuner winner)", "int",
+         numeric_key="grid_mxu", consumer="ops/toafit.py + ops/search.py via ops/autotune.py",
+         doc="bf16 MXU operands (f32 accumulation) for profile sweeps"),
+    Knob("CRIMP_TPU_DELTA_FOLD", "unset (off unless a tuner winner)", "int",
+         numeric_key="delta_fold", consumer="ops/anchored.py via ops/autotune.py",
+         doc="incremental delta-fold engine on/off"),
+    Knob("CRIMP_TPU_DELTA_FOLD_BUDGET", "1e-9 cycles", "float",
+         numeric_key="delta_fold", consumer="ops/deltafold.py via ops/autotune.py",
+         doc="delta-fold precision-guard budget"),
+    # -- throughput / caching (bit-identical by construction) ---------------
+    Knob("CRIMP_TPU_SHARD", "auto", "bool", consumer="parallel/mesh.py",
+         doc="multi-chip auto-sharding opt-out (mesh-shape invariance is pinned by tests)"),
+    Knob("CRIMP_TPU_AUTOTUNE", "auto", "enum", consumer="ops/autotune.py",
+         doc="tuner policy: off / auto (cached winners only) / eager"),
+    Knob("CRIMP_TPU_AUTOTUNE_CACHE", "~/.cache/crimp_tpu/autotune.json", "path",
+         consumer="ops/autotune.py",
+         doc="fingerprinted tuner-winner cache location"),
+    Knob("CRIMP_TPU_TOA_DENSE_WINDOW", "unset (auto: 32)", "int",
+         consumer="ops/toafit.py via ops/autotune.py",
+         doc="dense error-scan first-window width (any value is bit-identical)"),
+    Knob("CRIMP_TPU_STREAM_MIN_EVENTS", "unset (2^22)", "int",
+         consumer="ops/search.py + ops/resumable.py",
+         doc="event count above which grid chunks stream double-buffered (bit-exact)"),
+    Knob("CRIMP_TPU_FOLD_CACHE", "unset (in-process LRU)", "enum",
+         consumer="ops/deltafold.py",
+         doc="fold-product cache tier: off / mem / disk / explicit dir"),
+    Knob("CRIMP_TPU_COMPILE_CACHE", "~/.cache/crimp_tpu/jax_cache", "path",
+         consumer="utils/platform.py (import-time config)",
+         doc="persistent jax compilation cache dir; 0/off/none disables"),
+    Knob("CRIMP_TPU_COMPILE_CACHE_MIN_S", "0", "float",
+         consumer="utils/platform.py",
+         doc="minimum compile seconds before a kernel persists to the cache"),
+    Knob("CRIMP_TPU_TRACE_DIR", "unset", "path", consumer="utils/profiling.py",
+         doc="jax.profiler trace directory for the hot pipeline stages"),
+    # -- bench --------------------------------------------------------------
+    Knob("CRIMP_TPU_BENCH_PLATFORM", "unset", "str", consumer="bench.py",
+         doc="skip the bench's relay platform probe and label records with this"),
+    Knob("CRIMP_TPU_BENCH_PROBE_DEADLINE_S", "2400", "float", consumer="bench.py",
+         doc="total wall-clock budget for the bench's accelerator probe loop"),
+    Knob("CRIMP_TPU_RELAY_PORT", "8113", "int",
+         consumer="bench.py + scripts/watch_relay.sh",
+         doc="accelerator relay TCP port the probe loop polls"),
+    Knob("CRIMP_TPU_BENCH_PARTIAL", "unset", "path",
+         consumer="bench.py + scripts/extract_rates.py",
+         doc="per-sub-measurement sidecar path (session scripts set it; the "
+             "extractor reads it back)"),
+    Knob("CRIMP_TPU_BENCH_SCALE", "1.0", "float", consumer="bench.py",
+         doc="multiplies every bench workload size (with per-stage floors)"),
+    # -- session orchestration (shell) + test tier --------------------------
+    Knob("CRIMP_TPU_SESSION_DEADLINE", "unset", "int",
+         consumer="scripts/onchip_session.sh + scripts/watch_relay.sh",
+         doc="epoch-seconds deadline past which session stages are skipped"),
+    Knob("CRIMP_TPU_SESSION_DRYRUN", "0", "bool",
+         consumer="scripts/onchip_session.sh",
+         doc="run the session orchestration on CPU at tiny scale, relay untouched"),
+    Knob("CRIMP_TPU_PROBE_BACKOFF_S", "3600", "float",
+         consumer="scripts/watch_relay.sh",
+         doc="suppress fallback relay probes this long after a timeout-killed one"),
+    Knob("CRIMP_TPU_RUN_TPU_TESTS", "unset", "bool",
+         consumer="tests/test_tpu_tier.py + scripts/onchip_session.sh",
+         doc="opt into the opportunistic on-chip test tier"),
+    Knob("CRIMP_TPU_TIER_FORCE_CPU", "unset", "bool",
+         consumer="tests/test_tpu_tier.py + scripts/onchip_session.sh",
+         doc="run the tier's workloads at tiny scale on CPU (dry-run plumbing)"),
+))
+
+
+def knob(name: str) -> Knob:
+    """Look up a declared knob; unknown names raise (register first)."""
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"{name!r} is not a registered CRIMP_TPU knob; declare it in "
+            "crimp_tpu/knobs.py REGISTRY (see docs/analysis.md)"
+        ) from None
+
+
+def raw(name: str) -> str:
+    """The stripped env value of a REGISTERED knob ('' when unset).
+
+    This is the single sanctioned ``os.environ`` read for CRIMP_TPU
+    names; graftlint GL003 flags reads anywhere else.
+    """
+    knob(name)  # unknown names raise — registration is not optional
+    return os.environ.get(name, "").strip()  # graftlint: disable=GL003 (the registry's own accessor — the one sanctioned CRIMP_TPU env read)
+
+
+def is_set(name: str) -> bool:
+    """Whether the knob has a non-blank value in the environment."""
+    return bool(raw(name))
+
+
+def parse_onoff(value: str) -> bool | None:
+    """True for the ON_WORDS, False for the OFF_WORDS, None otherwise.
+
+    The shared truthy-string parser: callers decide whether None means
+    "auto", "unset" or "malformed" (their contracts differ and are pinned
+    by tests), but the recognized spellings are uniform everywhere.
+    """
+    low = value.strip().lower()
+    if low in ON_WORDS:
+        return True
+    if low in OFF_WORDS:
+        return False
+    return None
+
+
+def env_onoff(name: str, *, auto_ok: bool = True) -> bool | None:
+    """Parse a boolean-word knob: True/False for on/off words, None for
+    unset (or explicit "auto" when ``auto_ok``); anything else raises —
+    silently treating a typo ('of', 'yes') as unset would pick whatever
+    the auto-default is, the opposite of what the user plausibly meant.
+    """
+    env = raw(name)
+    state = parse_onoff(env)
+    if state is not None:
+        return state
+    if not env or (auto_ok and env.lower() == "auto"):
+        return None
+    raise ValueError(
+        f"{name}={env!r} not recognized; use 1/on/true/always, "
+        "0/off/false/never" + (", or auto/unset for the default" if auto_ok
+                               else "")
+    )
+
+
+def env_nonneg_int(name: str, valid=None) -> int | None:
+    """Parse an integer knob; unset/blank -> None, malformed raises
+    (matching CRIMP_TPU_GRID_BLOCKS: a typo'd override must not silently
+    fall back to defaults). Word forms deliberately raise here — tests pin
+    that "on"/"yes" are typos for the strict 0/1 switches."""
+    env = raw(name)
+    if not env:
+        return None
+    try:
+        val = int(env)
+    except ValueError:
+        raise ValueError(f"{name}={env!r} is not an integer") from None
+    if val < 0 or (valid is not None and val not in valid):
+        allowed = "/".join(map(str, valid)) if valid else ">= 0"
+        raise ValueError(f"{name}={env!r} out of range (expected {allowed})")
+    return val
+
+
+def env_pos_float(name: str) -> float | None:
+    """Parse a positive-float knob; unset/blank -> None, malformed or
+    non-positive/non-finite raises (same typo discipline as
+    :func:`env_nonneg_int`)."""
+    env = raw(name)
+    if not env:
+        return None
+    try:
+        val = float(env)
+    except ValueError:
+        raise ValueError(f"{name}={env!r} is not a number") from None
+    if not (0.0 < val < float("inf")):
+        raise ValueError(f"{name}={env!r} out of range (expected > 0)")
+    return val
+
+
+def env_float(name: str, default: float) -> float:
+    """Parse a float knob with a default for unset/blank; malformed raises."""
+    env = raw(name)
+    if not env:
+        return float(default)
+    try:
+        return float(env)
+    except ValueError:
+        raise ValueError(f"{name}={env!r} is not a number") from None
+
+
+def env_int(name: str, default: int) -> int:
+    """Parse an integer knob with a default for unset/blank; malformed raises."""
+    env = raw(name)
+    if not env:
+        return int(default)
+    try:
+        return int(env)
+    except ValueError:
+        raise ValueError(f"{name}={env!r} is not an integer") from None
+
+
+def env_str(name: str, default: str = "") -> str:
+    """The stripped string value, or ``default`` when unset/blank."""
+    return raw(name) or default
+
+
+def cache_home() -> str:
+    """$XDG_CACHE_HOME or ~/.cache — the shared base for every on-disk
+    cache tier (autotune winners, fold products, jax compile cache)."""
+    return os.environ.get("XDG_CACHE_HOME", "").strip() or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )

@@ -48,9 +48,10 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import pathlib
 import time
+
+from crimp_tpu import knobs
 
 logger = logging.getLogger(__name__)
 
@@ -77,8 +78,8 @@ DEFAULT_CANDIDATES = (
 
 def autotune_mode() -> str:
     """'off' | 'auto' | 'eager' from CRIMP_TPU_AUTOTUNE (malformed raises)."""
-    env = os.environ.get("CRIMP_TPU_AUTOTUNE", "auto").strip().lower()
-    if env in ("0", "off", "false", "never"):
+    env = knobs.raw("CRIMP_TPU_AUTOTUNE").lower()
+    if env in knobs.OFF_WORDS:
         return "off"
     if env in ("", "auto", "cache"):
         return "auto"
@@ -91,13 +92,10 @@ def autotune_mode() -> str:
 
 
 def cache_path() -> pathlib.Path:
-    env = os.environ.get("CRIMP_TPU_AUTOTUNE_CACHE", "").strip()
+    env = knobs.raw("CRIMP_TPU_AUTOTUNE_CACHE")
     if env:
         return pathlib.Path(env)
-    base = os.environ.get("XDG_CACHE_HOME", "").strip() or os.path.join(
-        os.path.expanduser("~"), ".cache"
-    )
-    return pathlib.Path(base) / "crimp_tpu" / "autotune.json"
+    return pathlib.Path(knobs.cache_home()) / "crimp_tpu" / "autotune.json"
 
 
 def _bucket(n: int) -> int:
@@ -181,8 +179,7 @@ def env_blocks_override(kernel: str) -> tuple[int, int] | None:
         return None
     from crimp_tpu.ops import search
 
-    env = os.environ.get("CRIMP_TPU_GRID_BLOCKS", "").strip()
-    if not env:
+    if not knobs.is_set("CRIMP_TPU_GRID_BLOCKS"):
         return None
     return search._env_blocks(*static_defaults(kernel))
 
@@ -279,21 +276,9 @@ def store_toafit(n_segments: int, n_events: int, entry: dict,
     _store_entry(toafit_cache_key(n_segments, n_events), entry, path)
 
 
-def _env_nonneg_int(name: str, valid=None) -> int | None:
-    """Parse an integer env knob; unset/blank -> None, malformed raises
-    (matching CRIMP_TPU_GRID_BLOCKS: a typo'd override must not silently
-    fall back to defaults)."""
-    env = os.environ.get(name, "").strip()
-    if not env:
-        return None
-    try:
-        val = int(env)
-    except ValueError:
-        raise ValueError(f"{name}={env!r} is not an integer") from None
-    if val < 0 or (valid is not None and val not in valid):
-        allowed = "/".join(map(str, valid)) if valid else ">= 0"
-        raise ValueError(f"{name}={env!r} out of range (expected {allowed})")
-    return val
+# parse helpers now live in the central knob registry; these aliases keep
+# the resolver-layer call sites (and ops/resumable.py) on their old names
+_env_nonneg_int = knobs.env_nonneg_int
 
 
 def resolve_toafit(n_segments: int, n_events: int) -> dict:
@@ -420,19 +405,7 @@ DELTA_FOLD_BUDGET_ENV = "CRIMP_TPU_DELTA_FOLD_BUDGET"
 DELTA_FOLD_BUDGET_DEFAULT = 1e-9
 
 
-def _env_pos_float(name: str) -> float | None:
-    """Parse a positive-float env knob; unset/blank -> None, malformed or
-    non-positive raises (same typo discipline as _env_nonneg_int)."""
-    env = os.environ.get(name, "").strip()
-    if not env:
-        return None
-    try:
-        val = float(env)
-    except ValueError:
-        raise ValueError(f"{name}={env!r} is not a number") from None
-    if not (0.0 < val < float("inf")):
-        raise ValueError(f"{name}={env!r} out of range (expected > 0)")
-    return val
+_env_pos_float = knobs.env_pos_float
 
 
 def delta_fold_defaults() -> dict:

@@ -53,7 +53,6 @@ from __future__ import annotations
 
 import hashlib
 import logging
-import os
 import pathlib
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -63,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from crimp_tpu import knobs
 from crimp_tpu.models import timing
 from crimp_tpu.models.timing import N_FREQ_TERMS, TimingParams
 
@@ -345,17 +345,14 @@ def fold_cache_mode() -> tuple[str, pathlib.Path | None]:
     ($XDG_CACHE_HOME/crimp_tpu/foldcache); any other value is taken as an
     explicit on-disk directory path.
     """
-    env = os.environ.get("CRIMP_TPU_FOLD_CACHE", "").strip()
+    env = knobs.raw("CRIMP_TPU_FOLD_CACHE")
     low = env.lower()
-    if low in ("0", "off", "false", "never"):
+    if low in knobs.OFF_WORDS:
         return "off", None
     if low in ("", "auto", "mem", "memory"):
         return "mem", None
     if low in ("1", "disk", "on", "true"):
-        base = os.environ.get("XDG_CACHE_HOME", "").strip() or os.path.join(
-            os.path.expanduser("~"), ".cache"
-        )
-        return "disk", pathlib.Path(base) / "crimp_tpu" / "foldcache"
+        return "disk", pathlib.Path(knobs.cache_home()) / "crimp_tpu" / "foldcache"
     return "disk", pathlib.Path(env)
 
 

@@ -24,9 +24,9 @@ round-3 on-chip A/B (v5e, 1e5 trials x 8.4e5 events) measured 91.5k vs
 
 from __future__ import annotations
 
-import os
-
 import jax.numpy as jnp
+
+from crimp_tpu import knobs
 
 # Least-squares fits on [-0.5, 0.5] (degree 11 odd / 12 even in x; fit and
 # error bounds reproduced by tests/test_search.py::TestPolyTrig).
@@ -67,18 +67,9 @@ def poly_trig_enabled(override: bool | None = None) -> bool:
     """
     if override is not None:
         return bool(override)
-    env = os.environ.get("CRIMP_TPU_POLY_TRIG", "").strip().lower()
-    if env in ("1", "on", "true", "always"):
-        return True
-    if env in ("0", "off", "false", "never"):
-        return False
-    if env == "auto":  # the documented default, spelled explicitly
-        env = ""
-    if env:
-        raise ValueError(
-            f"CRIMP_TPU_POLY_TRIG={os.environ['CRIMP_TPU_POLY_TRIG']!r} not recognized; "
-            "use 1/on/true/always, 0/off/false/never, or auto/unset for the backend default"
-        )
+    state = knobs.env_onoff("CRIMP_TPU_POLY_TRIG")
+    if state is not None:
+        return state
     import jax
 
     return jax.default_backend() == "tpu"

@@ -37,7 +37,6 @@ all-f64 path for bit-level CPU parity checks.
 
 from __future__ import annotations
 
-import os
 from functools import lru_cache, partial
 
 import jax
@@ -45,6 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
+from crimp_tpu import knobs
 from crimp_tpu.ops import fasttrig
 
 DEFAULT_EVENT_BLOCK = 1 << 16
@@ -59,7 +59,7 @@ def _env_blocks(default_event: int, default_trial: int) -> tuple[int, int]:
     without a code edit. Read once at import; malformed values raise
     (silently ignoring a typo'd perf knob would be invisible).
     """
-    env = os.environ.get("CRIMP_TPU_GRID_BLOCKS", "").strip()
+    env = knobs.raw("CRIMP_TPU_GRID_BLOCKS")
     if not env:
         return default_event, default_trial
     try:
@@ -129,11 +129,9 @@ def grid_fastpath_enabled(nharm: int, override: bool | None = None) -> bool:
     ("0"/"off" disables, "1"/"on" forces) > auto (nharm-based)."""
     if override is not None:
         return bool(override)
-    env = os.environ.get("CRIMP_TPU_GRID_FASTPATH", "auto").strip().lower()
-    if env in ("0", "off", "false", "never"):
-        return False
-    if env in ("1", "on", "true", "always"):
-        return True
+    state = knobs.parse_onoff(knobs.raw("CRIMP_TPU_GRID_FASTPATH"))
+    if state is not None:
+        return state
     return nharm <= GRID_FASTPATH_MAX_NHARM
 
 
@@ -867,8 +865,8 @@ def stream_min_events() -> int | None:
     CRIMP_TPU_STREAM_MIN_EVENTS: unset -> 2^22; "0"/"off" disables
     streaming; otherwise an integer threshold.
     """
-    env = os.environ.get("CRIMP_TPU_STREAM_MIN_EVENTS", "").strip().lower()
-    if env in ("0", "off", "false", "never"):
+    env = knobs.raw("CRIMP_TPU_STREAM_MIN_EVENTS").lower()
+    if env in knobs.OFF_WORDS:
         return None
     if not env:
         return 1 << 22

@@ -37,7 +37,6 @@ mesh-shape invariance of the statistics.
 
 from __future__ import annotations
 
-import os
 from functools import partial
 
 import jax
@@ -49,6 +48,8 @@ try:  # jax >= 0.6 exposes shard_map at top level
     from jax import shard_map
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
+
+from crimp_tpu import knobs
 
 from crimp_tpu.ops.search import (
     DEFAULT_EVENT_BLOCK,
@@ -73,10 +74,12 @@ SEGMENT_AXIS = "segments"
 
 
 def sharding_enabled() -> bool:
-    """Global opt-out: CRIMP_TPU_SHARD=0/off disables auto sharding."""
-    return os.environ.get("CRIMP_TPU_SHARD", "auto").strip().lower() not in (
-        "0", "off", "false", "never",
-    )
+    """Global opt-out: CRIMP_TPU_SHARD=0/off disables auto sharding.
+
+    Anything that is not an explicit off-word (including garbage) leaves
+    sharding enabled — this knob predates the raise-on-typo discipline and
+    scripts rely on unset/auto/unknown all meaning "on"."""
+    return knobs.parse_onoff(knobs.raw("CRIMP_TPU_SHARD")) is not False
 
 
 def auto_mesh(min_devices: int = 2) -> Mesh | None:
@@ -321,7 +324,7 @@ def z2_sharded(
         mesh = build_mesh()
     c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype,
                             use_fastpath, poly, use_mxu, reseed, mxu_bf16)
-    return np.asarray(jnp.sum(z2_from_sums(c[0], s[0], len(times)), axis=0))
+    return np.asarray(jnp.sum(z2_from_sums(c[0], s[0], len(times)), axis=0))  # graftlint: disable=GL005 (sums the replicated nharm axis, not the sharded event axis; per-trial order is fixed and the 8-device bitwise pin covers it)
 
 
 def h_sharded(
@@ -353,7 +356,7 @@ def z2_2d_sharded(
         mesh = build_mesh()
     c, s = _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype,
                             use_fastpath, poly, use_mxu, reseed, mxu_bf16)
-    return np.asarray(jnp.sum(z2_from_sums(c, s, len(times)), axis=1))
+    return np.asarray(jnp.sum(z2_from_sums(c, s, len(times)), axis=1))  # graftlint: disable=GL005 (sums the replicated nharm axis, not the sharded event axis; per-trial order is fixed and the 8-device bitwise pin covers it)
 
 
 # ---------------------------------------------------------------------------

@@ -240,8 +240,8 @@ def model_phase_residuals_delta(x_mjd, timmodel: dict, pvec, keys: list[str],
 
     pepoch = float(np.asarray(fit_tm.pepoch))
     delta_sec = np.asarray(
-        (np.asarray(t, dtype=np.longdouble) - np.longdouble(pepoch))
-        * np.longdouble(anchored.SECONDS_PER_DAY),
+        (np.asarray(t, dtype=np.longdouble) - np.longdouble(pepoch))  # graftlint: disable=GL004 (host-side epoch-delta in anchored.py's longdouble convention; only the rounded f64 result reaches the device basis)
+        * np.longdouble(anchored.SECONDS_PER_DAY),  # graftlint: disable=GL004 (same host-side epoch-delta; f64 is taken after the exact subtraction)
         dtype=np.float64,
     )
     spec = deltafold.basis_spec(fit_tm, np.asarray([pepoch]))

@@ -11,8 +11,9 @@ keeps that workaround in one place for every script that needs a
 
 from __future__ import annotations
 
-import os
 import pathlib
+
+from crimp_tpu import knobs
 
 
 def add_cpu_flag(parser) -> None:
@@ -39,15 +40,12 @@ def compilation_cache_dir() -> pathlib.Path | None:
     ``$XDG_CACHE_HOME/crimp_tpu/jax_cache``; ``0/off/none`` -> disabled;
     anything else is used as the directory path.
     """
-    env = os.environ.get("CRIMP_TPU_COMPILE_CACHE", "").strip()
+    env = knobs.raw("CRIMP_TPU_COMPILE_CACHE")
     if env.lower() in ("0", "off", "none", "false"):
         return None
     if env:
         return pathlib.Path(env)
-    base = os.environ.get("XDG_CACHE_HOME", "").strip() or os.path.join(
-        os.path.expanduser("~"), ".cache"
-    )
-    return pathlib.Path(base) / "crimp_tpu" / "jax_cache"
+    return pathlib.Path(knobs.cache_home()) / "crimp_tpu" / "jax_cache"
 
 
 def configure_compilation_cache() -> pathlib.Path | None:
@@ -70,7 +68,7 @@ def configure_compilation_cache() -> pathlib.Path | None:
     try:
         target.mkdir(parents=True, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", str(target))
-        min_s = float(os.environ.get("CRIMP_TPU_COMPILE_CACHE_MIN_S", "0") or 0)
+        min_s = knobs.env_float("CRIMP_TPU_COMPILE_CACHE_MIN_S", 0.0)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", min_s)
     except (OSError, ValueError, AttributeError):
         return None

@@ -14,11 +14,11 @@ temporal record is log timestamps). The TPU framework exposes two layers:
 from __future__ import annotations
 
 import contextlib
-import os
 import time
 
 import numpy as np
 
+from crimp_tpu import knobs
 from crimp_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -128,7 +128,7 @@ def trace(trace_dir: str | None = None):
 
     Directory resolution: explicit argument, else CRIMP_TPU_TRACE_DIR.
     """
-    target = trace_dir or os.environ.get("CRIMP_TPU_TRACE_DIR")
+    target = trace_dir or knobs.env_str("CRIMP_TPU_TRACE_DIR")
     if not target:
         yield
         return

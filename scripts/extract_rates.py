@@ -18,14 +18,25 @@ import pathlib
 import re
 import sys
 
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from crimp_tpu import knobs  # noqa: E402
+
 
 def _reconstruct_from_sidecar(out: pathlib.Path) -> dict | None:
     # Reconstruct a wedged/fallback bench from the per-sub-measurement
     # sidecar (bench.py emit_partial). Newest sidecar only — never stitch
     # rows from different runs/files into one frankenstein record (bench.py
-    # also truncates its sidecar at start for the same reason).
+    # also truncates its sidecar at start for the same reason). A sidecar
+    # named by CRIMP_TPU_BENCH_PARTIAL competes too: the session scripts
+    # may point bench at a path outside the outdir glob, and the extractor
+    # must read back the same file bench wrote.
     partial = {}
-    sidecars = sorted(out.glob("bench_partial*.jsonl"),
+    candidates = list(out.glob("bench_partial*.jsonl"))
+    env_sidecar = knobs.env_str("CRIMP_TPU_BENCH_PARTIAL")
+    if env_sidecar and pathlib.Path(env_sidecar).is_file():
+        candidates.append(pathlib.Path(env_sidecar))
+    sidecars = sorted({p.resolve() for p in candidates},
                       key=lambda p: p.stat().st_mtime, reverse=True)
     if sidecars:
         # newest ONLY — an empty newest sidecar means "nothing of the

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# graftlint standalone entry point: the same full-rule-set run the tier-1
+# gate (tests/test_analysis.py) performs, for use from a shell or CI step.
+#
+# Usage:
+#   bash scripts/lint.sh                 # scan crimp_tpu/ scripts/ bench.py
+#   bash scripts/lint.sh --format json   # machine-readable report
+#   bash scripts/lint.sh --baseline f    # fail only on findings new vs f
+#
+# Exit codes: 0 clean, 1 unwaived findings, 2 usage error.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+exec python -m crimp_tpu.analysis "$@"

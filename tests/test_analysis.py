@@ -1,0 +1,595 @@
+"""graftlint (crimp_tpu/analysis): per-rule fixtures, waiver semantics,
+knob-registry cross-checks, JSON/baseline plumbing, and the tier-1 gate
+that holds the shipped tree at zero unwaived findings.
+
+Fixture runs inject every cross-file input (registry, tools.md,
+resumable numeric_mode) through Config so no test depends on repo state
+except the gate tests, which exist precisely to depend on it.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from crimp_tpu import knobs
+from crimp_tpu.analysis import cli, engine
+from crimp_tpu.analysis.core import (
+    Config,
+    load_baseline,
+    new_findings,
+    save_baseline,
+)
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def run_tree(tmp_path, files, *, rules=None, registry=None,
+             tools_md_text="", numeric_keys=("fake_mode",),
+             gl004_allowlist=("pkg/anchor.py",),
+             gl005_modules=("pkg/parallel/",)):
+    """Write a fixture tree and run the analyzer over it."""
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+    tools = tmp_path / "tools.md"
+    tools.write_text(tools_md_text)
+    resumable = tmp_path / "resumable.py"
+    entries = ", ".join(f'"{k}": 1' for k in numeric_keys)
+    resumable.write_text(f"_numeric_mode = {{{entries}}}\n")
+    cfg = Config(
+        root=tmp_path,
+        paths=[tmp_path / rel for rel in files],
+        rules=rules,
+        registry={} if registry is None else registry,
+        tools_md=tools,
+        resumable_py=resumable,
+        gl004_allowlist=gl004_allowlist,
+        gl005_modules=gl005_modules,
+    )
+    return engine.run(cfg)
+
+
+def rules_fired(report):
+    return sorted({f.rule for f in report.unwaived})
+
+
+# ---------------------------------------------------------------------------
+# GL001 trace purity
+# ---------------------------------------------------------------------------
+
+
+class TestGL001:
+    def test_env_read_in_jitted_function_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import os
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x * float(os.environ.get("SCALE", "1"))
+        """}, rules=("GL001",))
+        assert rules_fired(rep) == ["GL001"]
+        assert "os.environ" in rep.unwaived[0].message
+
+    def test_transitive_reachability_through_helper(self, tmp_path):
+        # the violation is in an undecorated helper; only the call graph
+        # connects it to the jitted entry
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import os
+            import jax
+
+            def helper(x):
+                return x + len(os.getenv("A", ""))
+
+            @jax.jit
+            def entry(x):
+                return helper(x)
+        """}, rules=("GL001",))
+        assert rules_fired(rep) == ["GL001"]
+        assert "helper" in rep.unwaived[0].message
+
+    def test_lax_scan_body_is_traced(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import time
+            from jax import lax
+
+            def body(c, x):
+                time.sleep(0.1)
+                return c, x
+
+            def run(xs):
+                return lax.scan(body, 0, xs)
+        """}, rules=("GL001",))
+        assert rules_fired(rep) == ["GL001"]
+        assert "time.sleep" in rep.unwaived[0].message
+
+    def test_knob_accessor_from_traced_code_fires(self, tmp_path):
+        # knob resolution is host-side by contract; calling the registry
+        # accessors under a trace re-introduces implicit env reads
+        rep = run_tree(tmp_path, {
+            "crimp_tpu/knobs.py": """
+                def env_onoff(name):
+                    return True
+            """,
+            "pkg/mod.py": """
+                import jax
+                from crimp_tpu.knobs import env_onoff
+
+                @jax.jit
+                def f(x):
+                    if env_onoff("CRIMP_TPU_POLY_TRIG"):
+                        return x
+                    return -x
+            """,
+        }, rules=("GL001",))
+        assert rules_fired(rep) == ["GL001"]
+        assert "knob accessor" in rep.unwaived[0].message
+
+    def test_host_side_env_read_is_clean(self, tmp_path):
+        # the same read outside any traced body is the sanctioned pattern
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import os
+            import jax
+
+            MODE = os.environ.get("SCALE", "1")
+
+            def resolve():
+                return float(os.environ.get("SCALE", "1"))
+
+            @jax.jit
+            def f(x):
+                return x * 2.0
+        """}, rules=("GL001",))
+        assert rep.unwaived == []
+
+    def test_waived_with_reason(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import os
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x * len(os.environ)  # graftlint: disable=GL001 (fixture: deliberate violation kept for a test)
+        """}, rules=("GL001",))
+        assert rep.unwaived == []
+        waived = [f for f in rep.findings if f.waived]
+        assert waived and waived[0].rule == "GL001"
+        assert "fixture" in waived[0].reason
+
+
+# ---------------------------------------------------------------------------
+# GL002 host-sync hazards
+# ---------------------------------------------------------------------------
+
+
+class TestGL002:
+    def test_float_coercion_of_tracer_param(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x) * 2.0
+        """}, rules=("GL002",))
+        assert rules_fired(rep) == ["GL002"]
+        assert "float()" in rep.unwaived[0].message
+
+    def test_item_call_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.sum().item()
+        """}, rules=("GL002",))
+        assert rules_fired(rep) == ["GL002"]
+        assert ".item()" in rep.unwaived[0].message
+
+    def test_branch_on_tracer_param(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """}, rules=("GL002",))
+        assert rules_fired(rep) == ["GL002"]
+        assert "branch" in rep.unwaived[0].message
+
+    def test_static_annotated_param_branch_is_clean(self, tmp_path):
+        # int-annotated / kwonly / bool-defaulted params are static config
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import jax
+
+            @jax.jit
+            def f(x, nharm: int = 2, *, poly=False):
+                if nharm > 1 and poly:
+                    return x * nharm
+                return x
+        """}, rules=("GL002",))
+        assert rep.unwaived == []
+
+    def test_static_argnames_absorbed_from_jit_call(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import jax
+
+            def f(x, mode):
+                if mode == "fast":
+                    return x
+                return -x
+
+            g = jax.jit(f, static_argnames=("mode",))
+        """}, rules=("GL002",))
+        assert rep.unwaived == []
+
+    def test_is_none_check_is_clean(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import jax
+
+            @jax.jit
+            def f(x, w=None):
+                if w is None:
+                    return x
+                return x * w
+        """}, rules=("GL002",))
+        assert rep.unwaived == []
+
+
+# ---------------------------------------------------------------------------
+# GL003 knob-registry consistency
+# ---------------------------------------------------------------------------
+
+FAKE_REG = {
+    "CRIMP_TPU_FAKE": knobs.Knob(
+        "CRIMP_TPU_FAKE", "unset", "int", numeric_key="fake_mode"),
+}
+FAKE_DOCS = "| `CRIMP_TPU_FAKE` | unset | fixture knob |\n"
+
+
+class TestGL003:
+    def test_unregistered_env_read_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import os
+
+            X = os.environ.get("CRIMP_TPU_NOT_DECLARED", "")
+        """}, rules=("GL003",), registry=FAKE_REG, tools_md_text=FAKE_DOCS)
+        msgs = [f.message for f in rep.unwaived]
+        assert any("CRIMP_TPU_NOT_DECLARED" in m and "unregistered" in m
+                   for m in msgs)
+
+    def test_registered_read_outside_knobs_module_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import os
+
+            X = os.environ["CRIMP_TPU_FAKE"]
+        """}, rules=("GL003",), registry=FAKE_REG, tools_md_text=FAKE_DOCS)
+        msgs = [f.message for f in rep.unwaived]
+        assert any("outside" in m and "accessors" in m for m in msgs)
+
+    def test_read_inside_knobs_module_is_sanctioned(self, tmp_path):
+        rep = run_tree(tmp_path, {"crimp_tpu/knobs.py": """
+            import os
+
+            X = os.environ.get("CRIMP_TPU_FAKE", "")
+        """}, rules=("GL003",), registry=FAKE_REG, tools_md_text=FAKE_DOCS)
+        assert rep.unwaived == []
+
+    def test_shell_read_of_unregistered_knob_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"scripts/x.sh": """
+            #!/usr/bin/env bash
+            # a mention in a comment is not a read: $CRIMP_TPU_COMMENT_ONLY
+            echo "${CRIMP_TPU_SHELL_ONLY:-}"
+        """}, rules=("GL003",), registry=FAKE_REG, tools_md_text=FAKE_DOCS)
+        msgs = [f.message for f in rep.unwaived]
+        assert any("CRIMP_TPU_SHELL_ONLY" in m for m in msgs)
+        assert not any("CRIMP_TPU_COMMENT_ONLY" in m for m in msgs)
+
+    def test_missing_docs_row_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": "X = 1\n"},
+                       rules=("GL003",), registry=FAKE_REG, tools_md_text="")
+        msgs = [f.message for f in rep.unwaived]
+        assert any("CRIMP_TPU_FAKE" in m and "tools.md" in m for m in msgs)
+
+    def test_missing_numeric_mode_key_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": "X = 1\n"},
+                       rules=("GL003",), registry=FAKE_REG,
+                       tools_md_text=FAKE_DOCS, numeric_keys=())
+        msgs = [f.message for f in rep.unwaived]
+        assert any("fake_mode" in m and "numeric_mode" in m for m in msgs)
+
+    def test_fully_consistent_fixture_is_clean(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": "X = 1\n"},
+                       rules=("GL003",), registry=FAKE_REG,
+                       tools_md_text=FAKE_DOCS, numeric_keys=("fake_mode",))
+        assert rep.unwaived == []
+
+
+class TestGL003AgainstRepo:
+    """The removal tests the issue pins: deleting a knob's docs row or its
+    numeric_mode fingerprint key must turn the gate red."""
+
+    def _cfg(self, tools_md=None, resumable_py=None):
+        return Config(
+            root=REPO,
+            paths=[REPO / "crimp_tpu" / "knobs.py"],  # checks 3+4 are path-independent
+            rules=("GL003",),
+            tools_md=tools_md,
+            resumable_py=resumable_py,
+        )
+
+    def test_real_registry_is_consistent(self):
+        assert engine.run(self._cfg()).unwaived == []
+
+    def test_removing_a_docs_row_fails(self, tmp_path):
+        text = (REPO / "docs" / "tools.md").read_text()
+        pruned = "\n".join(l for l in text.splitlines()
+                           if "CRIMP_TPU_POLY_TRIG" not in l)
+        assert pruned != text
+        mutated = tmp_path / "tools.md"
+        mutated.write_text(pruned)
+        rep = engine.run(self._cfg(tools_md=mutated))
+        assert any("CRIMP_TPU_POLY_TRIG" in f.message for f in rep.unwaived)
+
+    def test_removing_a_numeric_mode_key_fails(self, tmp_path):
+        text = (REPO / "crimp_tpu" / "ops" / "resumable.py").read_text()
+        pruned = "\n".join(l for l in text.splitlines()
+                           if '"delta_fold": [' not in l)
+        assert pruned != text
+        mutated = tmp_path / "resumable.py"
+        mutated.write_text(pruned)
+        rep = engine.run(self._cfg(resumable_py=mutated))
+        assert any("delta_fold" in f.message and "numeric_mode" in f.message
+                   for f in rep.unwaived)
+
+    def test_registry_round_trip(self):
+        # every declared knob: namespaced, documented, numeric keys pinned
+        documented = (REPO / "docs" / "tools.md").read_text()
+        import ast as ast_mod
+
+        tree = ast_mod.parse(
+            (REPO / "crimp_tpu" / "ops" / "resumable.py").read_text())
+        keys = set()
+        for node in ast_mod.walk(tree):
+            if isinstance(node, ast_mod.Assign) and isinstance(
+                    node.value, ast_mod.Dict):
+                for tgt in node.targets:
+                    if getattr(tgt, "attr", getattr(tgt, "id", "")).endswith(
+                            "_numeric_mode"):
+                        keys = {k.value for k in node.value.keys
+                                if isinstance(k, ast_mod.Constant)}
+        assert keys, "resumable numeric_mode dict not found"
+        for name, k in knobs.REGISTRY.items():
+            assert name == k.name and name.startswith("CRIMP_TPU_")
+            assert name in documented, f"{name} missing from docs/tools.md"
+            if k.numeric:
+                assert k.numeric_key in keys, (
+                    f"{name} numeric_key {k.numeric_key!r} not fingerprinted")
+
+    def test_unknown_knob_name_raises(self):
+        with pytest.raises(KeyError, match="not a registered"):
+            knobs.raw("CRIMP_TPU_NO_SUCH_KNOB")
+
+    def test_parse_onoff_word_sets(self):
+        assert knobs.parse_onoff("ON") is True
+        assert knobs.parse_onoff("never") is False
+        assert knobs.parse_onoff("banana") is None
+
+    def test_env_onoff_typo_raises(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_SHARD", "of")
+        with pytest.raises(ValueError, match="CRIMP_TPU_SHARD"):
+            knobs.env_onoff("CRIMP_TPU_SHARD")
+
+    def test_strict_int_knobs_reject_word_forms(self, monkeypatch):
+        # pinned contract: the 0/1 switches never accept word spellings
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "yes")
+        with pytest.raises(ValueError, match="CRIMP_TPU_GRID_MXU"):
+            knobs.env_nonneg_int("CRIMP_TPU_GRID_MXU", valid=(0, 1))
+
+
+# ---------------------------------------------------------------------------
+# GL004 dtype discipline
+# ---------------------------------------------------------------------------
+
+
+class TestGL004:
+    def test_longdouble_outside_allowlist_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import numpy as np
+
+            X = np.longdouble(1.5)
+        """}, rules=("GL004",))
+        assert rules_fired(rep) == ["GL004"]
+
+    def test_mpmath_import_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": "import mpmath\n"},
+                       rules=("GL004",))
+        assert rules_fired(rep) == ["GL004"]
+
+    def test_allowlisted_module_is_clean(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/anchor.py": """
+            import numpy as np
+
+            X = np.longdouble(1.5)
+        """}, rules=("GL004",))
+        assert rep.unwaived == []
+
+    def test_file_level_waiver(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            # graftlint: disable-file=GL004 (fixture: host-side longdouble module by design)
+            import numpy as np
+
+            X = np.longdouble(1.5)
+            Y = np.longdouble(2.5)
+        """}, rules=("GL004",))
+        assert rep.unwaived == []
+        assert sum(f.waived for f in rep.findings) == 2
+
+
+# ---------------------------------------------------------------------------
+# GL005 order-sensitive reductions
+# ---------------------------------------------------------------------------
+
+
+class TestGL005:
+    def test_matmul_and_axis_sum_in_parallel_module(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/parallel/mod.py": """
+            import jax.numpy as jnp
+
+            def combine(a, b):
+                return a @ b + jnp.sum(a, axis=0)
+        """}, rules=("GL005",))
+        assert len(rep.unwaived) == 2
+        assert all(f.rule == "GL005" for f in rep.unwaived)
+
+    def test_same_code_outside_parallel_is_clean(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import jax.numpy as jnp
+
+            def combine(a, b):
+                return a @ b + jnp.sum(a, axis=0)
+        """}, rules=("GL005",))
+        assert rep.unwaived == []
+
+    def test_waived_with_parity_reason(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/parallel/mod.py": """
+            import jax.numpy as jnp
+
+            def combine(a):
+                return jnp.sum(a, axis=0)  # graftlint: disable=GL005 (fixture: replicated axis, fixed per-shard order)
+        """}, rules=("GL005",))
+        assert rep.unwaived == []
+
+
+# ---------------------------------------------------------------------------
+# GL000 waiver hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestWaiverHygiene:
+    def test_reasonless_waiver_suppresses_but_raises_gl000(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import numpy as np
+
+            X = np.longdouble(1.5)  # graftlint: disable=GL004
+        """}, rules=("GL004",))
+        assert rules_fired(rep) == ["GL000"]
+        assert any(f.rule == "GL004" and f.waived for f in rep.findings)
+        assert "no" in rep.unwaived[0].message and "reason" in rep.unwaived[0].message
+
+    def test_gl000_is_unwaivable(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            X = 1  # graftlint: disable=GL000,GL004 (trying to waive the waiver rule)
+            import numpy as np
+
+            Y = np.longdouble(1.5)  # graftlint: disable=GL004
+        """}, rules=("GL004",))
+        # the reasonless waiver on Y still yields GL000 despite the attempt
+        assert "GL000" in rules_fired(rep)
+
+    def test_waiver_syntax_in_string_is_inert(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": '''
+            MSG = "write '# graftlint: disable=GLxxx (reason)' on the line"
+        '''}, rules=("GL004",))
+        assert rep.unwaived == []
+
+    def test_syntax_error_yields_gl000(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": "def f(:\n    pass\n"},
+                       rules=("GL004",))
+        assert rules_fired(rep) == ["GL000"]
+        assert "parse" in rep.unwaived[0].message
+
+
+# ---------------------------------------------------------------------------
+# report schema / CLI / baseline
+# ---------------------------------------------------------------------------
+
+FINDING_KEYS = {"rule", "path", "line", "message", "waived", "reason"}
+
+
+class TestReportAndCli:
+    def test_json_schema(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import numpy as np
+
+            X = np.longdouble(1.5)
+        """}, rules=("GL004",))
+        doc = rep.to_dict()
+        assert doc["version"] == 1 and doc["tool"] == "graftlint"
+        assert doc["files_scanned"] == 1
+        assert doc["counts"] == {"GL004": 1}
+        assert all(set(f) == FINDING_KEYS for f in doc["findings"])
+        json.dumps(doc)  # must be serializable as-is
+
+    def test_cli_json_output_and_exit_codes(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import numpy as np\nX = np.longdouble(1.5)\n")
+        rc = cli.main(["--root", str(tmp_path), "--format", "json",
+                       "--rules", "GL004", str(bad)])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert doc["counts"] == {"GL004": 1}
+        assert [f["rule"] for f in doc["new_findings"]] == ["GL004"]
+
+        ok = tmp_path / "ok.py"
+        ok.write_text("X = 1\n")
+        assert cli.main(["--root", str(tmp_path), "--rules", "GL004",
+                         str(ok)]) == 0
+
+    def test_cli_missing_path_is_usage_error(self, tmp_path):
+        assert cli.main(["--root", str(tmp_path),
+                         str(tmp_path / "nope.py")]) == 2
+
+    def test_baseline_ratchet(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("import numpy as np\nX = np.longdouble(1.5)\n")
+        base = tmp_path / "base.json"
+        args = ["--root", str(tmp_path), "--rules", "GL004", str(bad)]
+        assert cli.main([*args, "--write-baseline", str(base)]) == 0
+        # old debt is forgiven...
+        assert cli.main([*args, "--baseline", str(base)]) == 0
+        # ...but a new finding still fails, even after unrelated line motion
+        bad.write_text("import numpy as np\n\n\nX = np.longdouble(1.5)\n"
+                       "Y = np.float128(2.5)\n")
+        assert cli.main([*args, "--baseline", str(base)]) == 1
+        capsys.readouterr()
+
+    def test_baseline_keys_are_line_free(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import numpy as np
+
+            X = np.longdouble(1.5)
+        """}, rules=("GL004",))
+        base = tmp_path / "b.json"
+        save_baseline(rep, base)
+        keys = load_baseline(base)
+        assert all("|" in k and not any(ch.isdigit() and k.split("|")[0] == ch
+                                        for ch in k.split("|")[1]) for k in keys)
+        assert new_findings(rep, keys) == []
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate
+# ---------------------------------------------------------------------------
+
+
+class TestRepoGate:
+    def test_shipped_tree_has_zero_unwaived_findings(self):
+        cfg = Config(root=REPO, paths=[REPO / "crimp_tpu", REPO / "scripts",
+                                       REPO / "bench.py"])
+        rep = engine.run(cfg)
+        assert rep.unwaived == [], "\n" + rep.render_text()
+
+    def test_every_waiver_carries_a_reason(self):
+        cfg = Config(root=REPO, paths=[REPO / "crimp_tpu", REPO / "scripts",
+                                       REPO / "bench.py"])
+        rep = engine.run(cfg)
+        for f in rep.findings:
+            if f.waived:
+                assert len(f.reason) >= 15, f.render()
