@@ -861,7 +861,15 @@ def main():
         # failure must not stop the real measurement
         log(f"[bench] carry-forward record failed: {exc}")
 
+    import os
+
+    # "forced" = the operator pinned the platform (knob or JAX_PLATFORMS);
+    # landing on cpu WITHOUT a pin is the r3-r5 silent-fallback situation
+    # the record must make machine-detectable.
+    platform_forced = bool(knobs.env_str("CRIMP_TPU_BENCH_PLATFORM")) or \
+        os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
     platform = choose_platform()
+    platform_fallback = platform == "cpu" and not platform_forced
     import jax
 
     if platform == "cpu":
@@ -869,7 +877,23 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         log("[bench] accelerator unavailable -> running on CPU (tagged)")
     log(f"[bench] platform: {platform}")
-    emit_partial("platform", {"platform": platform})
+    emit_partial("platform", {"platform": platform,
+                              "platform_fallback": platform_fallback})
+
+    # flight-record the whole measurement body as one obs run (no-op when
+    # CRIMP_TPU_OBS is off); ExitStack so the manifest is finalized before
+    # the final record (which points at it) is assembled
+    import contextlib
+
+    from crimp_tpu import obs
+
+    _obs_stack = contextlib.ExitStack()
+    _obs_run = _obs_stack.enter_context(obs.run("bench", platform=platform))
+
+    def obs_manifest_path():
+        # only this run's manifest; last_manifest_path() can be stale when
+        # obs is off but an earlier run in this process recorded one
+        return obs.last_manifest_path() if _obs_run is not None else None
 
     here = pathlib.Path(__file__).parent
     par = str(here / "tests/data/1e2259.par")
@@ -916,10 +940,14 @@ def main():
     built = step("surrogate", build_surrogate, par, intervals_path, template,
                  events_per_toa=events_per_toa)
     if built is None:
+        _obs_stack.close()
         record = {
             "metric": "toa_extraction_throughput_84toa_res1000",
             "value": None, "unit": "ToA/s", "vs_baseline": None,
-            "platform": platform, "errors": errors,
+            "platform": platform, "platform_fallback": platform_fallback,
+            "obs_manifest": obs_manifest_path(),
+            "obs_schema_version": obs.OBS_SCHEMA_VERSION,
+            "errors": errors,
         }
         emit_partial("final", record)
         print(json.dumps(record), flush=True)
@@ -978,6 +1006,9 @@ def main():
             f"{cfg4['toas_per_sec']:.1f} ToA/s; {100*cfg4['recovered_frac']:.1f}% of injected "
             f"shifts recovered within 5 sigma")
 
+    # close the flight-recorder run first so the manifest the record points
+    # at is already on disk (atomic) when the record line hits stdout
+    _obs_stack.close()
     record = {
         "metric": "toa_extraction_throughput_84toa_res1000",
         "value": round(toas["toas_per_sec"], 3) if toas else None,
@@ -986,6 +1017,9 @@ def main():
             round(toas["toas_per_sec"] / REFERENCE_TOAS_PER_SEC, 2) if toas else None
         ),
         "platform": platform,
+        "platform_fallback": platform_fallback,
+        "obs_manifest": obs_manifest_path(),
+        "obs_schema_version": obs.OBS_SCHEMA_VERSION,
         "cpu_scaled_workloads": on_cpu,
         "north_star_trials": north["n_trials_2d"] if north else None,
         "north_star_poly_trig": use_poly,

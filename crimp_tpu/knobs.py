@@ -127,6 +127,14 @@ REGISTRY: dict[str, Knob] = _build_registry((
          doc="minimum compile seconds before a kernel persists to the cache"),
     Knob("CRIMP_TPU_TRACE_DIR", "unset", "path", consumer="utils/profiling.py",
          doc="jax.profiler trace directory for the hot pipeline stages"),
+    # -- observability (host-side telemetry; numeric-neutral by contract) ---
+    Knob("CRIMP_TPU_OBS", "unset (off)", "bool", consumer="crimp_tpu/obs",
+         doc="flight-recorder telemetry: spans/counters + an atomic run manifest"),
+    Knob("CRIMP_TPU_OBS_DIR", "obs_runs", "path", consumer="crimp_tpu/obs",
+         doc="where run manifests + JSONL event streams land"),
+    Knob("CRIMP_TPU_OBS_EVENTS", "on (when obs is on)", "bool",
+         consumer="crimp_tpu/obs",
+         doc="append-only JSONL event stream alongside the manifest"),
     # -- bench --------------------------------------------------------------
     Knob("CRIMP_TPU_BENCH_PLATFORM", "unset", "str", consumer="bench.py",
          doc="skip the bench's relay platform probe and label records with this"),

@@ -1,0 +1,43 @@
+"""crimp_tpu.obs — host-side flight-recorder telemetry.
+
+Three pieces (docs/observability.md has the full contracts):
+
+- **Spans + metrics core** (:mod:`crimp_tpu.obs.core`): hierarchical
+  spans (run -> pipeline stage -> kernel) plus typed counters/gauges for
+  the quantities the engines compute and previously dropped on the floor
+  (events folded, ToAs fit, padding waste, delta-fold hit/guard trips,
+  autotune/fold-cache hits, MXU reseeds, compile telemetry).
+- **Flight recorder**: every pipeline entry point wrapped in
+  :func:`run` emits an append-only JSONL event stream and an atomic
+  end-of-run JSON manifest (span tree, counters, knob snapshot, the
+  resumable ``numeric_mode`` fingerprint, platform/device identity).
+- **Reporter** (:mod:`crimp_tpu.obs.report`, CLI ``python -m
+  crimp_tpu.obs``): summarize a manifest, diff two runs (span-level
+  slowdown attribution, counter deltas, knob drift), export Chrome
+  trace-event JSON and Prometheus text exposition.
+
+Everything here is host-side by construction: graftlint GL001 flags any
+call into this package reachable from traced code. Disabled
+(``CRIMP_TPU_OBS`` unset/off, the default) every hook is a strict no-op
+— :func:`span` returns a shared singleton and :func:`counter_add`
+returns after one global ``None`` check, so hot loops pay zero
+allocations and no pipeline byte changes.
+
+Import-safe: this package never imports jax (the reporter CLI and the
+relay-window scripts must run with no backend available).
+"""
+
+from crimp_tpu.obs.core import (  # noqa: F401
+    NULL_SPAN,
+    OBS_SCHEMA,
+    OBS_SCHEMA_VERSION,
+    active,
+    counter_add,
+    enabled,
+    gauge_set,
+    last_manifest_path,
+    record_numeric_mode,
+    record_span,
+    run,
+    span,
+)

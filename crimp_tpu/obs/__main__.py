@@ -1,0 +1,8 @@
+"""Entry point for ``python -m crimp_tpu.obs``."""
+
+import sys
+
+from crimp_tpu.obs.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

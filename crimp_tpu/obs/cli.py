@@ -1,0 +1,116 @@
+"""Reporter CLI: ``python -m crimp_tpu.obs <subcommand>``.
+
+Subcommands:
+
+- ``summary MANIFEST``        one-run summary (spans, counters, knobs)
+- ``diff A B``                attribute A→B slowdown; flag knob/numeric drift
+- ``trace MANIFEST [-o OUT]`` export Chrome trace-event JSON (Perfetto)
+- ``prom MANIFEST [-o OUT]``  export Prometheus text exposition
+- ``validate MANIFEST``       schema-check a manifest
+
+Exit codes: 0 = ok, 1 = validation problems / drift found with
+``--fail-on-drift``, 2 = usage or I/O error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from crimp_tpu.obs import report as rpt
+from crimp_tpu.obs.manifest import load_manifest, validate_manifest
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m crimp_tpu.obs",
+        description="crimp_tpu flight-recorder reporter: summarize, diff "
+                    "and export run manifests.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("summary", help="summarize one run manifest")
+    s.add_argument("manifest")
+    s.add_argument("--format", choices=("text", "json"), default="text")
+
+    d = sub.add_parser("diff", help="compare two run manifests (A -> B)")
+    d.add_argument("a")
+    d.add_argument("b")
+    d.add_argument("--format", choices=("text", "json"), default="text")
+    d.add_argument("--min-delta-s", type=float, default=0.005,
+                   help="ignore stage deltas below this (timer noise)")
+    d.add_argument("--fail-on-drift", action="store_true",
+                   help="exit 1 when knobs, numeric_mode or backend drifted")
+
+    t = sub.add_parser("trace", help="export Chrome trace-event JSON")
+    t.add_argument("manifest")
+    t.add_argument("-o", "--out", default=None, help="output path (default stdout)")
+
+    m = sub.add_parser("prom", help="export Prometheus text exposition")
+    m.add_argument("manifest")
+    m.add_argument("-o", "--out", default=None, help="output path (default stdout)")
+
+    v = sub.add_parser("validate", help="schema-check a manifest")
+    v.add_argument("manifest")
+    return p
+
+
+def _write(text: str, out: str | None) -> None:
+    if out:
+        with open(out, "w", encoding="utf-8") as fh:
+            fh.write(text)
+    else:
+        sys.stdout.write(text if text.endswith("\n") else text + "\n")
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.cmd == "validate":
+            with open(args.manifest, encoding="utf-8") as fh:
+                doc = json.load(fh)
+            problems = validate_manifest(doc)
+            for prob in problems:
+                print(f"{args.manifest}: {prob}")
+            print(f"{args.manifest}: "
+                  + ("OK" if not problems else f"{len(problems)} problem(s)"))
+            return 1 if problems else 0
+
+        if args.cmd == "summary":
+            doc = load_manifest(args.manifest)
+            if args.format == "json":
+                print(json.dumps({"summary": rpt.span_rollup(doc),
+                                  "counters": doc.get("counters"),
+                                  "gauges": doc.get("gauges"),
+                                  "knobs": doc.get("knobs"),
+                                  "run_id": doc["run_id"],
+                                  "wall_s": doc["wall_s"]}, indent=2))
+            else:
+                print(rpt.summarize(doc))
+            return 0
+
+        if args.cmd == "diff":
+            a = load_manifest(args.a)
+            b = load_manifest(args.b)
+            d = rpt.diff(a, b, min_delta_s=args.min_delta_s)
+            if args.format == "json":
+                print(json.dumps(d, indent=2))
+            else:
+                print(rpt.render_diff(d))
+            drifted = bool(d["knob_drift"] or d["numeric_mode_drift"]
+                           or d["backend_drift"])
+            return 1 if (args.fail_on_drift and drifted) else 0
+
+        if args.cmd == "trace":
+            doc = load_manifest(args.manifest)
+            _write(json.dumps(rpt.chrome_trace(doc), indent=1), args.out)
+            return 0
+
+        if args.cmd == "prom":
+            doc = load_manifest(args.manifest)
+            _write(rpt.prometheus(doc), args.out)
+            return 0
+    except (OSError, ValueError) as exc:
+        print(f"obs: {exc}", file=sys.stderr)
+        return 2
+    return 2

@@ -1,0 +1,386 @@
+"""Spans + metrics core and the flight recorder.
+
+Design constraints (enforced by tests/test_obs.py):
+
+- **Disabled is free.** With ``CRIMP_TPU_OBS`` off there is no active
+  :class:`RunRecorder`; :func:`span` returns the shared :data:`NULL_SPAN`
+  singleton and :func:`counter_add`/:func:`gauge_set`/:func:`record_span`
+  return after a single module-global ``None`` check. Zero allocations,
+  zero filesystem writes, zero branches beyond the guard.
+- **Thread-safe.** The double-buffered host→device streaming path runs
+  producer threads; all registry mutation happens under one re-entrant
+  lock and span parentage is tracked per-thread, so concurrent stages
+  record correctly instead of racing a bare dict.
+- **Crash-durable.** When events are on, every span/counter event is
+  appended (and flushed) to a JSONL stream as it happens; the manifest
+  is written atomically (tmp + rename) at run end, so a killed run still
+  leaves a readable flight record.
+- **Host-side by construction.** Never imports jax at module level and
+  never initializes a backend: platform identity is probed only from
+  backends some *other* code already brought up. graftlint GL001 bans
+  calls into this package from traced code.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+
+from crimp_tpu import knobs
+
+OBS_SCHEMA = "crimp_tpu.obs"
+OBS_SCHEMA_VERSION = 1
+
+_LOCK = threading.RLock()
+_RUN: "RunRecorder | None" = None
+_LAST_MANIFEST: str | None = None
+_RUN_SEQ = 0
+_TLS = threading.local()
+
+
+class _NullSpan:
+    """The disabled-path span: a shared, stateless no-op context manager.
+
+    ``span()`` returns this exact singleton whenever no run is active, so
+    instrumented hot loops allocate nothing when obs is off (the overhead
+    test pins ``span(...) is NULL_SPAN``).
+    """
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+def enabled() -> bool:
+    """Whether ``CRIMP_TPU_OBS`` asks for telemetry (malformed raises)."""
+    return bool(knobs.env_onoff("CRIMP_TPU_OBS"))
+
+
+def active() -> "RunRecorder | None":
+    """The in-flight run recorder, or None (the common, disabled case)."""
+    return _RUN
+
+
+def last_manifest_path() -> str | None:
+    """Path of the most recently finalized manifest in this process."""
+    return _LAST_MANIFEST
+
+
+def _stack() -> list:
+    try:
+        return _TLS.stack
+    except AttributeError:
+        _TLS.stack = []
+        return _TLS.stack
+
+
+class Span:
+    """A live hierarchical span; records on ``__exit__``.
+
+    Parentage comes from the per-thread span stack: spans opened on a
+    producer thread parent to that thread's innermost open span, falling
+    back to the run root. Construction reserves the span's slot in the
+    recorder so children opened before the parent closes still point at
+    a real index.
+    """
+
+    __slots__ = ("_rec", "_row", "_t0", "index")
+
+    def __init__(self, rec: "RunRecorder", name: str, kind: str, attrs: dict):
+        stack = _stack()
+        parent = stack[-1] if stack else 0
+        self._rec = rec
+        self._t0 = time.perf_counter()
+        self._row = {
+            "name": str(name),
+            "kind": str(kind),
+            "t0_s": round(self._t0 - rec.t0, 6),
+            "dur_s": None,
+            "parent": parent,
+            "thread": rec._thread_ordinal(),
+            "attrs": dict(attrs),
+        }
+        with _LOCK:
+            self.index = len(rec.spans)
+            rec.spans.append(self._row)
+        stack.append(self.index)
+
+    def set(self, **attrs):
+        """Attach attributes to the span while it is open."""
+        self._row["attrs"].update(attrs)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        stack = _stack()
+        if stack and stack[-1] == self.index:
+            stack.pop()
+        elif self.index in stack:  # unbalanced exit (generator teardown)
+            stack.remove(self.index)
+        self._row["dur_s"] = round(dur, 6)
+        if exc_type is not None:
+            self._row["attrs"]["error"] = f"{exc_type.__name__}: {exc}"
+        self._rec._emit({"ev": "span", "i": self.index, **self._row})
+        return False
+
+
+class RunRecorder:
+    """Accumulates one run's spans/counters/gauges; writes the artifacts.
+
+    Span 0 is always the run root. ``finalize()`` closes the root span,
+    gathers environment-shaped context (knob snapshot, platform/device
+    identity, compile telemetry) and atomically writes the manifest.
+    """
+
+    def __init__(self, name: str, attrs: dict):
+        global _RUN_SEQ
+        with _LOCK:
+            _RUN_SEQ += 1
+            seq = _RUN_SEQ
+        self.name = str(name)
+        self.t0 = time.perf_counter()
+        self.t0_unix = time.time()
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(self.t0_unix))
+        self.run_id = f"{self.name}-{stamp}-p{os.getpid()}-r{seq}"
+        self.dir = knobs.env_str("CRIMP_TPU_OBS_DIR", "obs_runs")
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.numeric_mode: dict | None = None
+        self.error: str | None = None
+        self.spans: list[dict] = [{
+            "name": self.name, "kind": "run", "t0_s": 0.0, "dur_s": None,
+            "parent": None, "thread": 0, "attrs": dict(attrs),
+        }]
+        self._threads: dict[int, int] = {threading.get_ident(): 0}
+        self._events = None
+        os.makedirs(self.dir, exist_ok=True)
+        if knobs.env_onoff("CRIMP_TPU_OBS_EVENTS") is not False:
+            path = os.path.join(self.dir, self.run_id + ".events.jsonl")
+            self._events = open(path, "a", encoding="utf-8")
+        self._emit({"ev": "run_start", "schema": OBS_SCHEMA,
+                    "schema_version": OBS_SCHEMA_VERSION,
+                    "run_id": self.run_id, "name": self.name,
+                    "t_start_unix": round(self.t0_unix, 3)})
+
+    def _thread_ordinal(self) -> int:
+        ident = threading.get_ident()
+        with _LOCK:
+            return self._threads.setdefault(ident, len(self._threads))
+
+    def _emit(self, event: dict) -> None:
+        if self._events is None:
+            return
+        with _LOCK:
+            if self._events is None:  # closed by finalize on another thread
+                return
+            json.dump(event, self._events, default=str)
+            self._events.write("\n")
+            self._events.flush()
+
+    def manifest(self) -> dict:
+        """The manifest document (schema contract in docs/observability.md)."""
+        return {
+            "schema": OBS_SCHEMA,
+            "schema_version": OBS_SCHEMA_VERSION,
+            "run_id": self.run_id,
+            "name": self.name,
+            "t_start_unix": round(self.t0_unix, 3),
+            "wall_s": self.spans[0]["dur_s"],
+            "error": self.error,
+            "platform": _platform_identity(),
+            "knobs": _knob_snapshot(),
+            "numeric_mode": self.numeric_mode,
+            "compile": _compile_snapshot(),
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "spans": list(self.spans),
+        }
+
+    def finalize(self) -> str:
+        """Close the root span, write the manifest atomically, return its path."""
+        with _LOCK:
+            if self.spans[0]["dur_s"] is None:
+                self.spans[0]["dur_s"] = round(time.perf_counter() - self.t0, 6)
+            doc = self.manifest()
+            path = os.path.join(self.dir, self.run_id + ".manifest.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1, sort_keys=False, default=str)
+                fh.write("\n")
+            os.replace(tmp, path)
+            if self._events is not None:
+                self._emit({"ev": "run_end", "run_id": self.run_id,
+                            "wall_s": self.spans[0]["dur_s"],
+                            "manifest": path, "error": self.error})
+                self._events.close()
+                self._events = None
+        return path
+
+
+def _knob_snapshot() -> dict[str, str]:
+    """Raw env values of every *set* registered knob (missing key = unset).
+
+    Reading through :func:`knobs.raw` keeps GL003's single-sanctioned-read
+    invariant; recording only set knobs makes knob drift a plain dict
+    diff (appeared / disappeared / changed).
+    """
+    snap = {}
+    for name in sorted(knobs.REGISTRY):
+        val = knobs.raw(name)
+        if val:
+            snap[name] = val
+    return snap
+
+
+def _platform_identity() -> dict:
+    """Backend/device identity from already-initialized backends only.
+
+    Importing jax (cheap, likely already done) is fine; *initializing a
+    backend is not* — ``import crimp_tpu`` and the obs CLI must never
+    acquire devices. So we peek at ``jax._src.xla_bridge``'s backend
+    table and return a stub when nothing has been brought up yet.
+    """
+    out = {"python": sys.version.split()[0], "backend": None, "devices": []}
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return out
+    try:
+        out["jax"] = jax.__version__
+        from jax._src import xla_bridge
+        backends = getattr(xla_bridge, "_backends", None) or {}
+        for plat, backend in backends.items():
+            out["backend"] = out["backend"] or plat
+            for d in backend.devices():
+                dev = {"id": d.id, "platform": d.platform,
+                       "kind": getattr(d, "device_kind", "")}
+                try:
+                    stats = d.memory_stats()
+                except Exception:  # noqa: BLE001 — CPU devices have none
+                    stats = None
+                if stats:
+                    dev["bytes_in_use"] = stats.get("bytes_in_use")
+                    dev["bytes_limit"] = stats.get("bytes_limit")
+                out["devices"].append(dev)
+    except Exception:  # noqa: BLE001 — identity is best-effort telemetry
+        pass
+    return out
+
+
+def _compile_snapshot() -> dict | None:
+    """The compile-cache telemetry, when the profiling listeners exist."""
+    try:
+        from crimp_tpu.utils import profiling
+        return profiling.compile_counters()
+    except Exception:  # noqa: BLE001 — telemetry must never fail a run
+        return None
+
+
+@contextlib.contextmanager
+def run(name: str, **attrs):
+    """Flight-record a pipeline entry point.
+
+    No-op (yields None) when obs is disabled. When a run is already
+    active, the inner entry point becomes a ``kind="run"`` span of the
+    outer run (bench wrapping a pipeline), so nesting never produces two
+    manifests for one invocation. Otherwise starts a RunRecorder and, on
+    exit — error or not — finalizes it into an atomic manifest.
+    """
+    global _RUN, _LAST_MANIFEST
+    if not enabled():
+        yield None
+        return
+    with _LOCK:
+        outer = _RUN
+        if outer is None:
+            rec = RunRecorder(name, attrs)
+            _RUN = rec
+    if outer is not None:
+        with Span(outer, name, "run", attrs) as s:
+            yield s
+        return
+    try:
+        yield rec
+    except BaseException as exc:
+        rec.error = f"{type(exc).__name__}: {exc}"
+        raise
+    finally:
+        with _LOCK:
+            _RUN = None
+        _stack().clear()
+        _LAST_MANIFEST = rec.finalize()
+
+
+def span(name: str, kind: str = "stage", **attrs):
+    """A hierarchical span context; the shared no-op when no run is active."""
+    rec = _RUN
+    if rec is None:
+        return NULL_SPAN
+    return Span(rec, name, kind, attrs)
+
+
+def record_span(name: str, dur_s: float, kind: str = "kernel", **attrs) -> None:
+    """Record an already-timed interval (the ``profiling.timed`` shim).
+
+    The span is parented to the calling thread's innermost open span and
+    back-dated so ``t0_s + dur_s`` lands at "now".
+    """
+    rec = _RUN
+    if rec is None:
+        return
+    stack = _stack()
+    row = {
+        "name": str(name), "kind": str(kind),
+        "t0_s": round(max(0.0, time.perf_counter() - rec.t0 - dur_s), 6),
+        "dur_s": round(float(dur_s), 6),
+        "parent": stack[-1] if stack else 0,
+        "thread": rec._thread_ordinal(),
+        "attrs": dict(attrs),
+    }
+    with _LOCK:
+        idx = len(rec.spans)
+        rec.spans.append(row)
+    rec._emit({"ev": "span", "i": idx, **row})
+
+
+def counter_add(name: str, value: float = 1) -> None:
+    """Add to a monotonic counter of the active run (no-op when none)."""
+    rec = _RUN
+    if rec is None:
+        return
+    with _LOCK:
+        rec.counters[name] = rec.counters.get(name, 0) + value
+
+
+def gauge_set(name: str, value: float) -> None:
+    """Set a point-in-time gauge of the active run (no-op when none)."""
+    rec = _RUN
+    if rec is None:
+        return
+    with _LOCK:
+        rec.gauges[name] = value
+
+
+def record_numeric_mode(mode: dict) -> None:
+    """Attach the resumable ``numeric_mode`` fingerprint to the run."""
+    rec = _RUN
+    if rec is None:
+        return
+    with _LOCK:
+        rec.numeric_mode = json.loads(json.dumps(mode, default=str))

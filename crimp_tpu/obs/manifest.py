@@ -1,0 +1,126 @@
+"""Run-manifest schema: validation + loading.
+
+The manifest is deliberately plain JSON with a flat span table (parent
+indices, not nesting) so it stays diffable with standard tools and cheap
+to validate without a jsonschema dependency. ``validate_manifest``
+returns a list of problems (empty = valid) rather than raising, so the
+reporter can degrade gracefully on partially-written artifacts while
+tests can assert exact emptiness.
+"""
+
+from __future__ import annotations
+
+import json
+
+from crimp_tpu.obs.core import OBS_SCHEMA, OBS_SCHEMA_VERSION
+
+# field name -> allowed types (None listed explicitly where nullable)
+_TOP_FIELDS: dict[str, tuple] = {
+    "schema": (str,),
+    "schema_version": (int,),
+    "run_id": (str,),
+    "name": (str,),
+    "t_start_unix": (int, float),
+    "wall_s": (int, float),
+    "error": (str, type(None)),
+    "platform": (dict,),
+    "knobs": (dict,),
+    "numeric_mode": (dict, type(None)),
+    "compile": (dict, type(None)),
+    "counters": (dict,),
+    "gauges": (dict,),
+    "spans": (list,),
+}
+
+_SPAN_FIELDS: dict[str, tuple] = {
+    "name": (str,),
+    "kind": (str,),
+    "t0_s": (int, float),
+    "dur_s": (int, float, type(None)),
+    "parent": (int, type(None)),
+    "thread": (int,),
+    "attrs": (dict,),
+}
+
+
+def validate_manifest(doc) -> list[str]:
+    """Schema-check a manifest document; returns problems (empty = valid)."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"manifest is {type(doc).__name__}, expected object"]
+    for field, types in _TOP_FIELDS.items():
+        if field not in doc:
+            problems.append(f"missing top-level field {field!r}")
+        elif not isinstance(doc[field], types):
+            problems.append(
+                f"{field!r} is {type(doc[field]).__name__}, expected "
+                + "/".join(t.__name__ for t in types))
+    if doc.get("schema") not in (None, OBS_SCHEMA):
+        problems.append(f"schema is {doc.get('schema')!r}, expected {OBS_SCHEMA!r}")
+    ver = doc.get("schema_version")
+    if isinstance(ver, int) and ver > OBS_SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {ver} is newer than this reader "
+            f"({OBS_SCHEMA_VERSION}); upgrade crimp_tpu to diff it")
+    spans = doc.get("spans")
+    if isinstance(spans, list):
+        if not spans:
+            problems.append("spans is empty (span 0 must be the run root)")
+        for i, row in enumerate(spans):
+            if not isinstance(row, dict):
+                problems.append(f"spans[{i}] is {type(row).__name__}, expected object")
+                continue
+            for field, types in _SPAN_FIELDS.items():
+                if field not in row:
+                    problems.append(f"spans[{i}] missing field {field!r}")
+                elif not isinstance(row[field], types):
+                    problems.append(
+                        f"spans[{i}].{field} is {type(row[field]).__name__}, "
+                        "expected " + "/".join(t.__name__ for t in types))
+            parent = row.get("parent")
+            if i == 0:
+                if parent is not None:
+                    problems.append("spans[0].parent must be null (run root)")
+            elif isinstance(parent, int) and not (0 <= parent < i):
+                problems.append(
+                    f"spans[{i}].parent={parent} out of range (parents "
+                    "precede children)")
+    for field in ("counters", "gauges"):
+        table = doc.get(field)
+        if isinstance(table, dict):
+            for key, val in table.items():
+                if not isinstance(val, (int, float)):
+                    problems.append(
+                        f"{field}[{key!r}] is {type(val).__name__}, expected number")
+    return problems
+
+
+def load_manifest(path: str) -> dict:
+    """Load + validate a manifest file; raises ValueError on a bad one."""
+    with open(path, encoding="utf-8") as fh:
+        try:
+            doc = json.load(fh)
+        except json.JSONDecodeError as exc:
+            raise ValueError(f"{path}: not valid JSON ({exc})") from None
+    problems = validate_manifest(doc)
+    if problems:
+        head = "; ".join(problems[:4]) + ("; ..." if len(problems) > 4 else "")
+        raise ValueError(f"{path}: invalid manifest ({head})")
+    return doc
+
+
+def span_paths(doc: dict) -> list[str]:
+    """``/``-joined name path for every span (root = its bare name).
+
+    The path is the diff key: two runs of the same pipeline produce the
+    same paths for the same stages regardless of absolute timing.
+    """
+    spans = doc["spans"]
+    paths: list[str] = []
+    for i, row in enumerate(spans):
+        parent = row.get("parent")
+        if parent is None or not (0 <= parent < i):
+            paths.append(row["name"])
+        else:
+            paths.append(paths[parent] + "/" + row["name"])
+    return paths

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from crimp_tpu import obs
 from crimp_tpu.models import timing
 from crimp_tpu.models.timing import N_FREQ_TERMS, TimingParams
 
@@ -313,6 +314,8 @@ def fold_segments(timMod, seg_times, t_ref_mjd=None, delta_fold=None):
     sizes = [t.size for t in seg_times]
     anchor_idx = np.repeat(np.arange(len(seg_times)), sizes)
     times_cat = np.concatenate(seg_times)
+    obs.counter_add("events_folded", int(times_cat.size))
+    obs.counter_add("fold_segments", len(seg_times))
     delta = anchor_deltas(times_cat, t_ref, anchor_idx)
 
     def exact():

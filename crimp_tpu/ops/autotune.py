@@ -51,7 +51,7 @@ import logging
 import pathlib
 import time
 
-from crimp_tpu import knobs
+from crimp_tpu import knobs, obs
 
 logger = logging.getLogger(__name__)
 
@@ -184,6 +184,11 @@ def env_blocks_override(kernel: str) -> tuple[int, int] | None:
     return search._env_blocks(*static_defaults(kernel))
 
 
+
+def _count_cache(hit: bool) -> None:
+    """Autotune-cache effectiveness telemetry (no-op when obs is off)."""
+    obs.counter_add("autotune_cache_hits" if hit else "autotune_cache_misses")
+
 def resolve_blocks(kernel: str, n_events: int, n_trials: int,
                    poly: bool = False,
                    event_block: int | None = None,
@@ -212,6 +217,7 @@ def resolve_blocks(kernel: str, n_events: int, n_trials: int,
             logger.warning("autotune cache lookup failed; using static "
                            "defaults", exc_info=True)
             resolved = None
+        _count_cache(resolved is not None)
         if resolved is None and mode == "eager":
             try:
                 out = tune(kernel, n_events, n_trials, poly=poly)
@@ -303,6 +309,7 @@ def resolve_toafit(n_segments: int, n_events: int) -> dict:
             logger.warning("toafit autotune cache lookup failed; using "
                            "static defaults", exc_info=True)
             cached = None
+        _count_cache(bool(cached))
         if cached:
             out.update(cached)
     if env_w is not None:
@@ -376,6 +383,7 @@ def resolve_grid_mxu(n_events: int, n_trials: int, poly: bool = False) -> dict:
             logger.warning("grid_mxu autotune cache lookup failed; using "
                            "static defaults", exc_info=True)
             cached = None
+        _count_cache(bool(cached))
         if cached:
             out.update(cached)
     if env_m is not None:
@@ -458,6 +466,7 @@ def resolve_delta_fold(n_events: int) -> dict:
             logger.warning("delta_fold autotune cache lookup failed; using "
                            "static defaults", exc_info=True)
             cached = None
+        _count_cache(bool(cached))
         if cached:
             out.update(cached)
     if env_d is not None:

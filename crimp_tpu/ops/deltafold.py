@@ -62,7 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from crimp_tpu import knobs
+from crimp_tpu import knobs, obs
 from crimp_tpu.models import timing
 from crimp_tpu.models.timing import N_FREQ_TERMS, TimingParams
 
@@ -456,6 +456,7 @@ def cached_fold(tm, times_cat, sizes, t_ref, delta, anchor_idx, exact_fn,
         dp = pvec - prod.pvec
         if not np.any(dp):
             info["mode"] = "cache"
+            obs.counter_add("delta_fold_cache_hits")
             _last_info = info
             return prod.phases.copy(), info
         basis = _ensure_basis(prod, tm, delta, anchor_idx)
@@ -467,11 +468,15 @@ def cached_fold(tm, times_cat, sizes, t_ref, delta, anchor_idx, exact_fn,
             folded = np.asarray(refold(prod.phases_dev, basis.b,
                                        jnp.asarray(dp)))
             info["mode"] = "delta"
+            obs.counter_add("delta_fold_refolds")
             _last_info = info
             return folded, info
         info["fallback"] = "budget"
+        obs.counter_add("delta_fold_guard_trips")
     elif prod is not None:
         info["fallback"] = "nonlinear"
+        obs.counter_add("delta_fold_nonlinear_fallbacks")
+    obs.counter_add("delta_fold_exact_folds")
     folded = np.asarray(exact_fn())
     if mode != "off":
         new = FoldProduct(phases=folded, t_ref=np.asarray(t_ref),

@@ -35,6 +35,8 @@ import pathlib
 
 import numpy as np
 
+from crimp_tpu import obs
+
 CHUNK_TRIALS = 50_000
 
 
@@ -418,18 +420,24 @@ class ResumableScan:
         ordering is unchanged (chunk i is on disk before i+1's save
         starts), so a kill mid-run leaves the same resumable state.
         """
-        done = set(self.done_chunks())
-        parts: list[np.ndarray | None] = [None] * self.n_chunks
-        pending: tuple[int, object] | None = None
-        for i in range(self.n_chunks):
-            if i in done:
-                parts[i] = np.load(self._chunk_path(i))
-                continue
-            rows_dev = self._compute_chunk_device(i)
-            if pending is not None:
-                self._finish_chunk(pending[0], pending[1], parts, progress)
-            pending = (i, rows_dev)
-        if pending is not None:
-            self._finish_chunk(pending[0], pending[1], parts, progress)
-        power = np.concatenate(parts, axis=1)
-        return power[0] if self._squeeze else power
+        with obs.run("resumable_scan", statistic=self.statistic,
+                     n_chunks=self.n_chunks):
+            obs.record_numeric_mode(self._numeric_mode)
+            done = set(self.done_chunks())
+            obs.counter_add("chunks_resumed", len(done))
+            obs.counter_add("chunks_computed", self.n_chunks - len(done))
+            parts: list[np.ndarray | None] = [None] * self.n_chunks
+            pending: tuple[int, object] | None = None
+            with obs.span("chunk_loop", kind="stage"):
+                for i in range(self.n_chunks):
+                    if i in done:
+                        parts[i] = np.load(self._chunk_path(i))
+                        continue
+                    rows_dev = self._compute_chunk_device(i)
+                    if pending is not None:
+                        self._finish_chunk(pending[0], pending[1], parts, progress)
+                    pending = (i, rows_dev)
+                if pending is not None:
+                    self._finish_chunk(pending[0], pending[1], parts, progress)
+            power = np.concatenate(parts, axis=1)
+            return power[0] if self._squeeze else power

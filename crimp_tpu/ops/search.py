@@ -44,7 +44,7 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
-from crimp_tpu import knobs
+from crimp_tpu import knobs, obs
 from crimp_tpu.ops import fasttrig
 
 DEFAULT_EVENT_BLOCK = 1 << 16
@@ -394,7 +394,10 @@ def _grid_sums_dispatch(times, f0, df, n_freq, nharm, poly,
     use_mxu, rs, b16 = _resolve_grid_mxu(n, n_freq, poly, mxu, reseed, mxu_bf16)
     eb, tb = resolve_blocks("grid_mxu" if use_mxu else "grid", n, n_freq,
                             poly, event_block, trial_block)
+    obs.counter_add("grid_trials", n_freq)
     if use_mxu:
+        # one exact-sincos reseed row per `rs` trials of every trial block
+        obs.counter_add("grid_mxu_reseeds", -(-int(n_freq) // max(1, int(rs))))
         c, s = harmonic_sums_uniform_mxu(
             jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
             reseed=rs, mxu_bf16=b16,
@@ -558,7 +561,10 @@ def z2_power_2d_grid(
     eb, tb = resolve_blocks("grid_mxu" if use_mxu else "grid", int(n),
                             int(n_freq), poly, event_block, trial_block)
     fd = jnp.asarray(fdots, dtype=jnp.float64)
+    obs.counter_add("grid_trials", int(n_freq) * int(fd.shape[0]))
     if use_mxu:
+        obs.counter_add("grid_mxu_reseeds",
+                        -(-int(n_freq) // max(1, int(rs))) * int(fd.shape[0]))
         c, s = harmonic_sums_uniform_2d_mxu(
             times, f0, df, n_freq, fd, nharm, eb, tb, poly=poly,
             reseed=rs, mxu_bf16=b16,
@@ -1289,48 +1295,52 @@ class PeriodSearch:
         return pmesh.auto_mesh()
 
     def ztest(self) -> np.ndarray:
-        mesh = self._mesh()
-        if mesh is not None:
-            from crimp_tpu.parallel import mesh as pmesh
+        with obs.span("z2_scan", n_trials=len(self.freq),
+                      n_events=len(self.time), nharm=self.nbrHarm):
+            mesh = self._mesh()
+            if mesh is not None:
+                from crimp_tpu.parallel import mesh as pmesh
 
-            return pmesh.z2_sharded(
-                self.time - self.t0, self.freq, self.nbrHarm, mesh,
-                use_fastpath=self.use_grid_fastpath, poly=self._poly(),
-            )
-        grid = self._grid()
-        if grid is not None:
-            f0, df = grid
+                return pmesh.z2_sharded(
+                    self.time - self.t0, self.freq, self.nbrHarm, mesh,
+                    use_fastpath=self.use_grid_fastpath, poly=self._poly(),
+                )
+            grid = self._grid()
+            if grid is not None:
+                f0, df = grid
+                return np.asarray(
+                    z2_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm,
+                                  poly=self._poly())
+                )
+            eb, tb = self._general_blocks()
             return np.asarray(
-                z2_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm,
-                              poly=self._poly())
+                z2_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm,
+                         event_block=eb, trial_block=tb, poly=self._poly())
             )
-        eb, tb = self._general_blocks()
-        return np.asarray(
-            z2_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm,
-                     event_block=eb, trial_block=tb, poly=self._poly())
-        )
 
     def htest(self) -> np.ndarray:
-        mesh = self._mesh()
-        if mesh is not None:
-            from crimp_tpu.parallel import mesh as pmesh
+        with obs.span("h_scan", n_trials=len(self.freq),
+                      n_events=len(self.time), nharm=self.nbrHarm):
+            mesh = self._mesh()
+            if mesh is not None:
+                from crimp_tpu.parallel import mesh as pmesh
 
-            return pmesh.h_sharded(
-                self.time - self.t0, self.freq, self.nbrHarm, mesh,
-                use_fastpath=self.use_grid_fastpath, poly=self._poly(),
-            )
-        grid = self._grid()
-        if grid is not None:
-            f0, df = grid
+                return pmesh.h_sharded(
+                    self.time - self.t0, self.freq, self.nbrHarm, mesh,
+                    use_fastpath=self.use_grid_fastpath, poly=self._poly(),
+                )
+            grid = self._grid()
+            if grid is not None:
+                f0, df = grid
+                return np.asarray(
+                    h_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm,
+                                 poly=self._poly())
+                )
+            eb, tb = self._general_blocks()
             return np.asarray(
-                h_power_grid(self._centered(), f0, df, len(self.freq), self.nbrHarm,
-                             poly=self._poly())
+                h_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm,
+                        event_block=eb, trial_block=tb, poly=self._poly())
             )
-        eb, tb = self._general_blocks()
-        return np.asarray(
-            h_power(self._centered(), jnp.asarray(self.freq), self.nbrHarm,
-                    event_block=eb, trial_block=tb, poly=self._poly())
-        )
 
     def twod_ztest(self, freq_dot):
         """2-D Z^2 on a (log10 |nudot|) grid, spin-down sign enforced.
@@ -1340,35 +1350,37 @@ class PeriodSearch:
         """
         log_fdots = np.asarray(freq_dot, dtype=np.float64)
         signed = -(10.0**log_fdots)
-        mesh = self._mesh(len(self.time) * len(self.freq) * len(signed))
-        if mesh is not None:
-            from crimp_tpu.parallel import mesh as pmesh
+        with obs.span("z2_2d_scan", n_trials=len(self.freq) * len(signed),
+                      n_events=len(self.time), nharm=self.nbrHarm):
+            mesh = self._mesh(len(self.time) * len(self.freq) * len(signed))
+            if mesh is not None:
+                from crimp_tpu.parallel import mesh as pmesh
 
-            power = pmesh.z2_2d_sharded(
-                self.time - self.t0, self.freq, signed, self.nbrHarm, mesh,
-                use_fastpath=self.use_grid_fastpath, poly=self._poly(),
-            )
-        elif (grid := self._grid()) is not None:
-            f0, df = grid
-            power = np.asarray(
-                z2_power_2d_grid(
-                    self._centered(), f0, df, len(self.freq),
-                    jnp.asarray(signed), self.nbrHarm, poly=self._poly(),
+                power = pmesh.z2_2d_sharded(
+                    self.time - self.t0, self.freq, signed, self.nbrHarm, mesh,
+                    use_fastpath=self.use_grid_fastpath, poly=self._poly(),
                 )
-            )
-        else:
-            eb, tb = self._general_blocks()
-            power = np.asarray(
-                z2_power_2d(
-                    self._centered(),
-                    jnp.asarray(self.freq),
-                    jnp.asarray(signed),
-                    self.nbrHarm,
-                    event_block=eb,
-                    trial_block=tb,
-                    poly=self._poly(),
+            elif (grid := self._grid()) is not None:
+                f0, df = grid
+                power = np.asarray(
+                    z2_power_2d_grid(
+                        self._centered(), f0, df, len(self.freq),
+                        jnp.asarray(signed), self.nbrHarm, poly=self._poly(),
+                    )
                 )
-            )
+            else:
+                eb, tb = self._general_blocks()
+                power = np.asarray(
+                    z2_power_2d(
+                        self._centered(),
+                        jnp.asarray(self.freq),
+                        jnp.asarray(signed),
+                        self.nbrHarm,
+                        event_block=eb,
+                        trial_block=tb,
+                        poly=self._poly(),
+                    )
+                )
         rows = np.column_stack(
             [
                 np.tile(self.freq, len(log_fdots)),

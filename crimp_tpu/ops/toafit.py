@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.scipy.special import i0
 
+from crimp_tpu import obs
 from crimp_tpu.models.profiles import (
     CAUCHY,
     FOURIER,
@@ -767,6 +768,7 @@ def fit_toas_batch_auto(
     n_seg = phases.shape[0]
     if n_seg == 0:
         return {}
+    obs.counter_add("toas_fit", n_seg)
     cfg = resolve_runtime_cfg(cfg, n_seg, phases.shape[1])
     n_devices = len(jax.devices()) if pmesh.sharding_enabled() else 1
     if n_devices < 2 or n_seg < n_devices:
@@ -839,9 +841,15 @@ def pad_segments(phase_list: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray]:
     S = len(phase_list)
     phases = np.zeros((S, n_max))
     masks = np.zeros((S, n_max), dtype=bool)
+    used = 0
     for i, p in enumerate(phase_list):
         phases[i, : len(p)] = p
         masks[i, : len(p)] = True
+        used += len(p)
+    # padding-waste telemetry: cells the masked kernels compute vs cells
+    # that carry real events (the bucketed path exists to shrink this gap)
+    obs.counter_add("pad_cells_total", S * n_max)
+    obs.counter_add("pad_cells_used", used)
     return phases, masks
 
 

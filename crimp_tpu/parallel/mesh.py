@@ -49,7 +49,7 @@ try:  # jax >= 0.6 exposes shard_map at top level
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
-from crimp_tpu import knobs
+from crimp_tpu import knobs, obs
 
 from crimp_tpu.ops.search import (
     DEFAULT_EVENT_BLOCK,
@@ -272,6 +272,8 @@ def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath,
     padding to the mesh tiling; dispatches grid fast path vs general."""
     ev_size = mesh.shape[EVENT_AXIS]
     tr_size = mesh.shape[TRIAL_AXIS]
+    obs.counter_add("mesh_sharded_calls")
+    obs.gauge_set("mesh_devices", ev_size * tr_size)
     n_freq = len(freqs)
     t_pad, w_pad = _pad_to(np.asarray(times, dtype=np.float64), ev_size)
     fd = jnp.asarray(np.atleast_1d(np.asarray(fdots, dtype=np.float64)))
@@ -382,6 +384,8 @@ def delta_refold_sharded(tm, t_ref_mjd, folded, delta, anchor_idx, dp,
         mesh = Mesh(np.asarray(jax.devices()), (EVENT_AXIS,))
     n = len(folded)
     n_dev = mesh.shape[EVENT_AXIS]
+    obs.counter_add("mesh_sharded_calls")
+    obs.gauge_set("mesh_devices", n_dev)
     spec = deltafold.basis_spec(tm, t_ref_mjd)
     folded_p, _ = _pad_to(np.asarray(folded, dtype=np.float64), n_dev)
     delta_p, _ = _pad_to(np.asarray(delta, dtype=np.float64), n_dev)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
+from crimp_tpu import obs
 from crimp_tpu.io import parfile as parfile_io
 from crimp_tpu.io import tim as tim_io
 from crimp_tpu.io.parfile import get_parameter_value
@@ -312,7 +313,18 @@ def plot_residuals(toas_pre_fit: pd.DataFrame, phase_residuals_post_fit, plotnam
 # ---------------------------------------------------------------------------
 
 
-def fit_toas(
+def fit_toas(*args, **kwargs) -> dict:
+    """Full fit pipeline; returns {'keys', 'values', 'stats', ...}.
+
+    Flight-recorded as an obs run (``fit_toas``): the sampler/optimizer
+    and post-fit refold land as stage spans, with ToA counts and
+    delta-fold counters from the ops layer (docs/observability.md).
+    """
+    with obs.run("fit_toas"):
+        return _fit_toas_impl(*args, **kwargs)
+
+
+def _fit_toas_impl(
     timfile_path: str,
     par_in: str,
     par_out: str,
@@ -350,7 +362,9 @@ def fit_toas(
             raise ValueError("init_yaml (bounds) is required for the MCMC path")
         prior = load_prior(init_yaml)
         print("Running ensemble MCMC (JAX stretch-move sampler)...")
-        _, flat, summaries = run_mcmc(
+        obs.counter_add("toas_fit_input", len(toas_pre_fit))
+        with obs.span("fit_mcmc", steps=mcmc_steps, walkers=mcmc_walkers):
+            _, flat, summaries = run_mcmc(
             toas_pre_fit["ToA"], toas_pre_fit["phase"], toas_pre_fit["phase_err_cycle"],
             init_par, keys, prior, steps=mcmc_steps, burn=mcmc_burn, walkers=mcmc_walkers,
             corner_pdf=corner_plot_path, chain_npy=chain_npy, flat_npy=flat_npy, seed=seed,
@@ -373,14 +387,17 @@ def fit_toas(
         )
         from scipy.optimize import minimize
 
+        obs.counter_add("toas_fit_input", len(toas_pre_fit))
         if any("wave" in k.lower() for k in keys):
             if any("glep_" in k.lower() for k in keys):
                 logger.warning(
                     "Fitting glitch epochs and waves simultaneously is discouraged."
                 )
-            res = minimize(nll, p0, method="BFGS", options={"maxiter": int(1e5)}, tol=1e-16, jac="3-point")
+            with obs.span("fit_mle", method="BFGS", n_free=len(keys)):
+                res = minimize(nll, p0, method="BFGS", options={"maxiter": int(1e5)}, tol=1e-16, jac="3-point")
         else:
-            res = minimize(nll, p0, method="Nelder-Mead", options={"maxiter": int(1e5)})
+            with obs.span("fit_mle", method="Nelder-Mead", n_free=len(keys)):
+                res = minimize(nll, p0, method="Nelder-Mead", options={"maxiter": int(1e5)})
         best_vec = res.x
         _, full_dict = fit_utils.inject_free_params(init_par, best_vec, keys)
         uncertainties = None
@@ -389,13 +406,14 @@ def fit_toas(
     # post-fit refold: the delta-fold engine serves it as one basis matmul
     # when the free set is linear and the knob is on; None falls back to
     # the exact host-longdouble path (bit-identical when the knob is off)
-    post_fit = fit_utils.model_phase_residuals_delta(
-        toas_pre_fit["ToA"].to_numpy(), init_par, best_vec, keys
-    )
-    if post_fit is None:
-        post_fit = fit_utils.model_phase_residuals(
+    with obs.span("postfit_refold"):
+        post_fit = fit_utils.model_phase_residuals_delta(
             toas_pre_fit["ToA"].to_numpy(), init_par, best_vec, keys
         )
+        if post_fit is None:
+            post_fit = fit_utils.model_phase_residuals(
+                toas_pre_fit["ToA"].to_numpy(), init_par, best_vec, keys
+            )
     if residual_plot is not None:
         suffix = f"_{best_fit}" if mcmc else ""
         plot_residuals(toas_pre_fit, post_fit, residual_plot + suffix)

@@ -21,6 +21,7 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
+from crimp_tpu import obs
 from crimp_tpu.io import parfile as parfile_io
 from crimp_tpu.io import tim as tim_io
 from crimp_tpu.models import timing
@@ -104,7 +105,18 @@ def _fit_windows_batched(windows: list[dict], steps: int, burn: int, walkers: in
     return out
 
 
-def generate_local_ephemerides(
+def generate_local_ephemerides(*args, **kwargs) -> pd.DataFrame:
+    """Sliding-window local F0/F1; returns the detrended ephemerides table.
+
+    Flight-recorded as an obs run (``local_ephem``): window discovery and
+    the single batched ensemble fit land as stage spans, with a
+    windows-fit counter (docs/observability.md).
+    """
+    with obs.run("local_ephem"):
+        return _generate_local_ephemerides_impl(*args, **kwargs)
+
+
+def _generate_local_ephemerides_impl(
     tim_file: str,
     parfile: str,
     interval_days: float = 90.0,
@@ -214,13 +226,16 @@ def generate_local_ephemerides(
             current_start += jump_days
 
     # ---- all windows sample together in one batched device program -------
-    all_summaries = (
-        _fit_windows_batched(
-            windows_found, mcmc_steps, mcmc_burn, mcmc_walkers, debug_with_plots
+    obs.counter_add("ephem_windows_fit", len(windows_found))
+    with obs.span("ephem_batched_fit", windows=len(windows_found),
+                  steps=mcmc_steps, walkers=mcmc_walkers):
+        all_summaries = (
+            _fit_windows_batched(
+                windows_found, mcmc_steps, mcmc_burn, mcmc_walkers, debug_with_plots
+            )
+            if windows_found
+            else []
         )
-        if windows_found
-        else []
-    )
     for w, summaries in zip(windows_found, all_summaries):
         med_vec = np.array([summaries[k]["median"] for k in FIT_KEYS])
         _, full_dict = fit_utils.inject_free_params(w["local_par"], med_vec, FIT_KEYS)

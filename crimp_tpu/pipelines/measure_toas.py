@@ -20,6 +20,7 @@ from __future__ import annotations
 import numpy as np
 import pandas as pd
 
+from crimp_tpu import obs
 from crimp_tpu.io import template as template_io
 from crimp_tpu.io.events import EventFile
 from crimp_tpu.models import profiles, timing
@@ -37,7 +38,19 @@ TOA_COLUMNS = [
 ]
 
 
-def measure_toas(
+def measure_toas(*args, **kwargs) -> pd.DataFrame:
+    """Measure ToAs for every interval; returns the ToA table.
+
+    Flight-recorded as an obs run (``measure_toas``) when CRIMP_TPU_OBS
+    is on: the anchored-fold / batched-fit / H-test stages land as spans
+    in the run manifest, with events-folded / ToAs-fit / padding-waste
+    counters from the ops layer (docs/observability.md).
+    """
+    with obs.run("measure_toas"):
+        return _measure_toas_impl(*args, **kwargs)
+
+
+def _measure_toas_impl(
     evtFile: str,
     timMod: str,
     tempModPP: str,

@@ -14,22 +14,32 @@ temporal record is log timestamps). The TPU framework exposes two layers:
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 
 import numpy as np
 
-from crimp_tpu import knobs
+from crimp_tpu import knobs, obs
 from crimp_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
+# The legacy flat timing registry — kept as a shim over crimp_tpu.obs
+# (timed() records into both). The lock matters: the double-buffered
+# host->device streaming path times blocks from producer threads, which
+# would race the bare setdefault/append pattern.
 _KERNEL_TIMES: dict[str, list[float]] = {}
+_TIMES_LOCK = threading.Lock()
 
 
 def force(result):
     """Materialize a JAX value (or pytree leaf dict) on the host."""
     if isinstance(result, dict):
         return {k: force(v) for k, v in result.items()}
+    if isinstance(result, tuple) and hasattr(result, "_fields"):
+        # namedtuple: the constructor takes fields positionally, not an
+        # iterable — type(result)(generator) is a TypeError.
+        return type(result)(*(force(v) for v in result))
     if isinstance(result, (list, tuple)):
         return type(result)(force(v) for v in result)
     try:
@@ -41,23 +51,32 @@ def force(result):
 @contextlib.contextmanager
 def timed(name: str, sync=None):
     """Time a block; if ``sync`` is a callable it is invoked at exit to
-    force device completion (e.g. ``lambda: np.asarray(out)``)."""
+    force device completion (e.g. ``lambda: np.asarray(out)``).
+
+    Recorded in the legacy per-process registry (``kernel_times()``) and,
+    when a flight-recorder run is active, as a ``kind="kernel"`` span of
+    the current stage (crimp_tpu.obs supersedes this module's registry;
+    the dict survives as a shim for existing callers)."""
     t0 = time.perf_counter()
     yield
     if sync is not None:
         force(sync() if callable(sync) else sync)
     dt = time.perf_counter() - t0
-    _KERNEL_TIMES.setdefault(name, []).append(dt)
+    with _TIMES_LOCK:
+        _KERNEL_TIMES.setdefault(name, []).append(dt)
+    obs.record_span(name, dt, kind="kernel")
     logger.info("[timing] %s: %.3fs", name, dt)
 
 
 def kernel_times() -> dict[str, list[float]]:
     """All recorded block timings of this process (name -> durations)."""
-    return {k: list(v) for k, v in _KERNEL_TIMES.items()}
+    with _TIMES_LOCK:
+        return {k: list(v) for k, v in _KERNEL_TIMES.items()}
 
 
 def reset_kernel_times() -> None:
-    _KERNEL_TIMES.clear()
+    with _TIMES_LOCK:
+        _KERNEL_TIMES.clear()
 
 
 _COMPILE_EVENTS: dict[str, int] = {}

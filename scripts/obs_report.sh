@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Flight-recorder reporter entry point (docs/observability.md), for use
+# from a shell or CI step — mirrors scripts/lint.sh.
+#
+# Usage:
+#   bash scripts/obs_report.sh summary  obs_runs/<run>.json
+#   bash scripts/obs_report.sh diff     obs_runs/<a>.json obs_runs/<b>.json
+#   bash scripts/obs_report.sh trace    obs_runs/<run>.json -o out.json
+#   bash scripts/obs_report.sh prom     obs_runs/<run>.json
+#   bash scripts/obs_report.sh validate obs_runs/<run>.json
+#
+# Exit codes: 0 ok, 1 drift (diff --fail-on-drift) / invalid manifest,
+# 2 usage or I/O error.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+exec python -m crimp_tpu.obs "$@"

@@ -130,6 +130,27 @@ class TestGL001:
         assert rules_fired(rep) == ["GL001"]
         assert "knob accessor" in rep.unwaived[0].message
 
+    def test_obs_api_from_traced_code_fires(self, tmp_path):
+        # telemetry is host-side by construction; an obs hook reached from
+        # a jitted body would inject host I/O (and a trace recompile hazard)
+        rep = run_tree(tmp_path, {
+            "crimp_tpu/obs/__init__.py": """
+                def counter_add(name, value=1):
+                    return None
+            """,
+            "pkg/mod.py": """
+                import jax
+                from crimp_tpu import obs
+
+                @jax.jit
+                def f(x):
+                    obs.counter_add("events_folded", 1)
+                    return x
+            """,
+        }, rules=("GL001",))
+        assert rules_fired(rep) == ["GL001"]
+        assert "obs API" in rep.unwaived[0].message
+
     def test_host_side_env_read_is_clean(self, tmp_path):
         # the same read outside any traced body is the sanctioned pattern
         rep = run_tree(tmp_path, {"pkg/mod.py": """
@@ -585,6 +606,16 @@ class TestRepoGate:
                                        REPO / "bench.py"])
         rep = engine.run(cfg)
         assert rep.unwaived == [], "\n" + rep.render_text()
+
+    def test_obs_unreachable_from_traced_code(self):
+        """The GL001 obs deny-list must never fire on the shipped tree:
+        every obs hook sits in host-side dispatch code, outside the
+        traced-reachability closure."""
+        cfg = Config(root=REPO, paths=[REPO / "crimp_tpu", REPO / "scripts",
+                                       REPO / "bench.py"], rules=("GL001",))
+        rep = engine.run(cfg)
+        obs_hits = [f for f in rep.findings if "obs API" in f.message]
+        assert obs_hits == [], "\n".join(f.render() for f in obs_hits)
 
     def test_every_waiver_carries_a_reason(self):
         cfg = Config(root=REPO, paths=[REPO / "crimp_tpu", REPO / "scripts",

@@ -426,6 +426,74 @@ class TestStdoutRecordDiscipline:
         assert record["z2_timed_region"] == bench.Z2_TIMED_REGION
         assert set(record["errors"]) >= {"warmup", "z2", "grid_mxu",
                                          "delta_fold", "toas"}
+        # the probe landed on cpu WITHOUT an operator pin: the record must
+        # say so (the r3-r5 silent-fallback benches, made greppable)
+        assert record["platform_fallback"] is True
+        assert record["obs_schema_version"] == 1
+        assert "obs_manifest" in record
+
+    def test_pinned_cpu_is_not_a_fallback(self, monkeypatch, tmp_path,
+                                          capsys):
+        """An operator-pinned CPU run is a deliberate measurement, not the
+        silent-fallback failure mode — platform_fallback must stay false."""
+        import json as json_mod
+
+        import bench
+
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        monkeypatch.setenv("CRIMP_TPU_BENCH_PLATFORM", "cpu")
+        monkeypatch.delenv("CRIMP_TPU_BENCH_PARTIAL", raising=False)
+        monkeypatch.setattr(bench, "build_surrogate",
+                            lambda *a, **k: (np.arange(5.0), np.arange(3)))
+
+        def boom(*a, **k):
+            raise RuntimeError("stage exploded")
+
+        for stage in ("bench_warmup", "bench_z2", "bench_grid_mxu",
+                      "bench_delta_fold", "bench_toas", "bench_north_star",
+                      "bench_config4"):
+            monkeypatch.setattr(bench, stage, boom)
+
+        bench.main()
+        lines = [ln for ln in capsys.readouterr().out.splitlines()
+                 if ln.strip()]
+        record = json_mod.loads(lines[-1])
+        assert record["platform"] == "cpu"
+        assert record["platform_fallback"] is False
+
+    def test_obs_enabled_bench_records_manifest_path(self, monkeypatch,
+                                                     tmp_path, capsys):
+        """With CRIMP_TPU_OBS on, the bench record must point at a valid
+        manifest that is already on disk when the record line prints."""
+        import json as json_mod
+
+        import bench
+        from crimp_tpu.obs.manifest import load_manifest
+
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        monkeypatch.setenv("CRIMP_TPU_BENCH_PLATFORM", "cpu")
+        monkeypatch.setenv("CRIMP_TPU_OBS", "1")
+        monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(tmp_path / "obs"))
+        monkeypatch.delenv("CRIMP_TPU_BENCH_PARTIAL", raising=False)
+        monkeypatch.setattr(bench, "build_surrogate",
+                            lambda *a, **k: (np.arange(5.0), np.arange(3)))
+
+        def boom(*a, **k):
+            raise RuntimeError("stage exploded")
+
+        for stage in ("bench_warmup", "bench_z2", "bench_grid_mxu",
+                      "bench_delta_fold", "bench_toas", "bench_north_star",
+                      "bench_config4"):
+            monkeypatch.setattr(bench, stage, boom)
+
+        bench.main()
+        lines = [ln for ln in capsys.readouterr().out.splitlines()
+                 if ln.strip()]
+        record = json_mod.loads(lines[-1])
+        assert record["obs_manifest"]
+        doc = load_manifest(record["obs_manifest"])
+        assert doc["name"] == "bench"
+        assert doc["schema_version"] == record["obs_schema_version"]
 
 
 class TestBenchEnvelope:

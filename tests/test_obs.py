@@ -1,0 +1,457 @@
+"""Flight-recorder observability (crimp_tpu/obs): the disabled path must
+be free and numeric-neutral, the enabled path must leave a valid atomic
+manifest, and the reporter must attribute slowdowns and flag drift.
+
+The disabled-overhead and byte-identity tests are the contract that lets
+obs hooks live inside every pipeline: CRIMP_TPU_OBS off means zero
+filesystem writes, the shared NULL_SPAN singleton, and bit-identical
+pipeline outputs.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import pathlib
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from crimp_tpu import obs  # noqa: E402
+from crimp_tpu.obs import cli, core, report  # noqa: E402
+from crimp_tpu.obs.manifest import (  # noqa: E402
+    load_manifest,
+    span_paths,
+    validate_manifest,
+)
+from crimp_tpu.ops.resumable import ResumableScan  # noqa: E402
+from crimp_tpu.utils import profiling  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """A failed test must not leak an active run into its neighbors."""
+    yield
+    core._RUN = None
+    try:
+        core._TLS.stack.clear()
+    except AttributeError:
+        pass
+
+
+@pytest.fixture
+def obs_on(monkeypatch, tmp_path):
+    out = tmp_path / "obs"
+    monkeypatch.setenv("CRIMP_TPU_OBS", "1")
+    monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(out))
+    return out
+
+
+@pytest.fixture
+def obs_off(monkeypatch, tmp_path):
+    out = tmp_path / "obs_should_stay_absent"
+    monkeypatch.delenv("CRIMP_TPU_OBS", raising=False)
+    monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(out))
+    return out
+
+
+@pytest.fixture(scope="module")
+def events():
+    rng = np.random.RandomState(7)
+    n = 3000
+    base = rng.uniform(0, 40000.0, n)
+    pulsed = rng.rand(n) < 0.4
+    phase = rng.vonmises(0.0, 2.0, n) / (2 * np.pi)
+    times = np.where(pulsed, (np.round(base * 0.1432) + phase) / 0.1432, base)
+    return np.sort(times) - 20000.0
+
+
+FREQS = np.linspace(0.1428, 0.1436, 300)  # 2 chunks of 150
+
+
+# ---------------------------------------------------------------------------
+# Disabled path: free and byte-neutral
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledOverhead:
+    def test_span_is_the_shared_null_singleton(self, obs_off):
+        assert obs.active() is None
+        assert obs.span("stage", trials=5) is obs.NULL_SPAN
+        assert obs.span("other") is obs.NULL_SPAN  # same object every call
+        with obs.NULL_SPAN as s:
+            assert s.set(anything=1) is obs.NULL_SPAN
+
+    def test_metric_hooks_are_noops(self, obs_off):
+        obs.counter_add("x", 3)
+        obs.gauge_set("g", 1.0)
+        obs.record_span("k", 0.1)
+        obs.record_numeric_mode({"m": 1})
+        assert obs.active() is None
+
+    def test_run_yields_none(self, obs_off):
+        with obs.run("pipe") as rec:
+            assert rec is None
+
+    def test_pipeline_makes_zero_obs_writes(self, obs_off, events):
+        ResumableScan(events, FREQS, nharm=2, chunk_trials=150).run()
+        assert not obs_off.exists(), "obs-off run touched the obs dir"
+
+    def test_outputs_bit_identical_on_vs_off(self, monkeypatch, tmp_path,
+                                             events):
+        """Numeric-neutral by contract: turning the recorder on must not
+        change a single bit of the pipeline output."""
+        monkeypatch.delenv("CRIMP_TPU_OBS", raising=False)
+        p_off = ResumableScan(events, FREQS, nharm=2, chunk_trials=150).run()
+        monkeypatch.setenv("CRIMP_TPU_OBS", "1")
+        monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(tmp_path / "obs"))
+        p_on = ResumableScan(events, FREQS, nharm=2, chunk_trials=150).run()
+        np.testing.assert_array_equal(p_on, p_off)
+
+
+# ---------------------------------------------------------------------------
+# Enabled path: manifest round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestManifestRoundTrip:
+    def test_run_writes_valid_atomic_manifest(self, obs_on, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_POLY_TRIG", "1")  # lands in the snapshot
+        with obs.run("demo", flavor="test") as rec:
+            obs.counter_add("events_folded", 1000)
+            obs.counter_add("events_folded", 24)
+            obs.gauge_set("mesh_devices", 1)
+            obs.record_numeric_mode({"trig": "poly"})
+            with obs.span("stage_a", trials=7):
+                obs.record_span("kern", 0.25)
+        path = obs.last_manifest_path()
+        assert path and pathlib.Path(path).parent == obs_on
+        assert not list(obs_on.glob("*.tmp"))  # atomic rename, no debris
+        doc = load_manifest(path)  # raises on any schema problem
+        assert doc["name"] == "demo"
+        assert doc["run_id"] == rec.run_id
+        assert doc["error"] is None
+        assert doc["counters"]["events_folded"] == 1024
+        assert doc["gauges"]["mesh_devices"] == 1
+        assert doc["numeric_mode"] == {"trig": "poly"}
+        assert doc["knobs"]["CRIMP_TPU_POLY_TRIG"] == "1"
+        assert doc["knobs"]["CRIMP_TPU_OBS"] == "1"
+        # span tree: run root, stage child, back-dated kernel grandchild
+        assert [(s["name"], s["parent"]) for s in doc["spans"]] == [
+            ("demo", None), ("stage_a", 0), ("kern", 1)]
+        assert span_paths(doc) == ["demo", "demo/stage_a", "demo/stage_a/kern"]
+        assert doc["spans"][2]["dur_s"] == pytest.approx(0.25)
+
+    def test_events_jsonl_stream(self, obs_on):
+        with obs.run("streamed"):
+            with obs.span("s1"):
+                pass
+        stream = list(obs_on.glob("*.events.jsonl"))
+        assert len(stream) == 1
+        rows = [json.loads(ln) for ln in stream[0].read_text().splitlines()]
+        assert rows[0]["ev"] == "run_start"
+        assert rows[-1]["ev"] == "run_end"
+        assert any(r["ev"] == "span" and r["name"] == "s1" for r in rows)
+
+    def test_events_stream_suppressible(self, obs_on, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_OBS_EVENTS", "0")
+        with obs.run("quiet"):
+            pass
+        assert not list(obs_on.glob("*.events.jsonl"))
+        assert list(obs_on.glob("*.manifest.json"))  # manifest still lands
+
+    def test_nested_run_becomes_span_one_manifest(self, obs_on):
+        with obs.run("outer") as rec:
+            with obs.run("inner") as inner:
+                assert isinstance(inner, core.Span)
+            assert obs.active() is rec
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["name"] == "outer"
+        assert [s["name"] for s in doc["spans"]] == ["outer", "inner"]
+        assert doc["spans"][1]["kind"] == "run"
+        assert len(list(obs_on.glob("*.manifest.json"))) == 1
+
+    def test_error_captured_and_manifest_still_written(self, obs_on):
+        with pytest.raises(RuntimeError, match="boom"):
+            with obs.run("exploding"):
+                raise RuntimeError("boom")
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["error"] == "RuntimeError: boom"
+
+    def test_validator_rejects_broken_manifests(self):
+        good = _synthetic("ok", 1.0, {"stage": 0.5})
+        assert validate_manifest(good) == []
+        assert validate_manifest([]) != []
+        missing = dict(good)
+        missing.pop("counters")
+        assert any("counters" in p for p in validate_manifest(missing))
+        future = dict(good, schema_version=obs.OBS_SCHEMA_VERSION + 1)
+        assert any("newer" in p for p in validate_manifest(future))
+        bad_parent = json.loads(json.dumps(good))
+        bad_parent["spans"][1]["parent"] = 5  # parents must precede children
+        assert any("parent" in p for p in validate_manifest(bad_parent))
+
+
+# ---------------------------------------------------------------------------
+# Obs-enabled end-to-end pipeline run
+# ---------------------------------------------------------------------------
+
+
+class TestPipelineFlightRecord:
+    def test_resumable_scan_manifest(self, obs_on, events, tmp_path):
+        store = tmp_path / "ckpt"
+        ResumableScan(events, FREQS, nharm=2, store=str(store),
+                      chunk_trials=150).run()
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["name"] == "resumable_scan"
+        assert doc["counters"]["chunks_computed"] == 2
+        assert doc["counters"].get("chunks_resumed", 0) == 0
+        # the resumable numeric-mode fingerprint rides in the manifest
+        assert doc["numeric_mode"] is not None
+        assert "kernel_version" in doc["numeric_mode"] or doc["numeric_mode"]
+        assert "resumable_scan/chunk_loop" in span_paths(doc)
+
+        # resume: everything cached -> counters flip
+        ResumableScan(events, FREQS, nharm=2, store=str(store),
+                      chunk_trials=150).run()
+        doc2 = load_manifest(obs.last_manifest_path())
+        assert doc2["run_id"] != doc["run_id"]
+        assert doc2["counters"]["chunks_resumed"] == 2
+        assert doc2["counters"]["chunks_computed"] == 0
+
+    def test_timed_kernels_feed_the_active_run(self, obs_on):
+        profiling.reset_kernel_times()
+        with obs.run("shimmed"):
+            with profiling.timed("fold_kernel"):
+                pass
+        assert "fold_kernel" in profiling.kernel_times()  # legacy API intact
+        doc = load_manifest(obs.last_manifest_path())
+        kernels = [s for s in doc["spans"] if s["kind"] == "kernel"]
+        assert [k["name"] for k in kernels] == ["fold_kernel"]
+
+
+# ---------------------------------------------------------------------------
+# Thread safety
+# ---------------------------------------------------------------------------
+
+
+class TestThreadSafety:
+    def test_concurrent_timed_blocks_record_completely(self, obs_on):
+        """The streaming producer-thread scenario: N threads hammer
+        timed() inside one run; every measurement must land in both the
+        legacy ledger and the span table (the bare setdefault/append
+        pattern dropped entries under this load)."""
+        profiling.reset_kernel_times()
+        n_threads, n_each = 8, 50
+
+        def work():
+            for _ in range(n_each):
+                with profiling.timed("concurrent_kernel"):
+                    pass
+
+        with obs.run("threaded"):
+            threads = [threading.Thread(target=work) for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert len(profiling.kernel_times()["concurrent_kernel"]) == \
+            n_threads * n_each
+        doc = load_manifest(obs.last_manifest_path())
+        kernels = [s for s in doc["spans"] if s["name"] == "concurrent_kernel"]
+        assert len(kernels) == n_threads * n_each
+        assert all(k["parent"] == 0 for k in kernels)
+        assert validate_manifest(doc) == []
+
+    def test_counter_adds_from_threads_sum_exactly(self, obs_on):
+        def work():
+            for _ in range(200):
+                obs.counter_add("hits")
+
+        with obs.run("counting"):
+            threads = [threading.Thread(target=work) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["counters"]["hits"] == 1600
+
+
+# ---------------------------------------------------------------------------
+# Reporter: diff, trace, prometheus
+# ---------------------------------------------------------------------------
+
+
+def _synthetic(run_id, wall, stage_durs, knobs_set=None, numeric_mode=None,
+               backend="cpu", counters=None):
+    spans = [{"name": "pipe", "kind": "run", "t0_s": 0.0, "dur_s": wall,
+              "parent": None, "thread": 0, "attrs": {}}]
+    for name, dur in stage_durs.items():
+        spans.append({"name": name, "kind": "stage", "t0_s": 0.01,
+                      "dur_s": dur, "parent": 0, "thread": 0, "attrs": {}})
+    return {
+        "schema": obs.OBS_SCHEMA, "schema_version": obs.OBS_SCHEMA_VERSION,
+        "run_id": run_id, "name": "pipe", "t_start_unix": 1e9,
+        "wall_s": wall, "error": None,
+        "platform": {"backend": backend, "devices": []},
+        "knobs": dict(knobs_set or {}), "numeric_mode": numeric_mode,
+        "compile": None, "counters": dict(counters or {}), "gauges": {},
+        "spans": spans,
+    }
+
+
+class TestReporterDiff:
+    def test_attributes_injected_slowdown_to_the_right_stage(self):
+        a = _synthetic("run-a", 2.0, {"fold": 0.5, "scan": 1.0},
+                       counters={"grid_trials": 100})
+        b = _synthetic("run-b", 4.5, {"fold": 0.5, "scan": 3.4},
+                       counters={"grid_trials": 100})
+        assert validate_manifest(a) == [] and validate_manifest(b) == []
+        d = report.diff(a, b)
+        assert d["wall_delta_s"] == pytest.approx(2.5)
+        # the slowest-moving stage leads the attribution
+        assert d["stages"][0]["path"] == "pipe/scan"
+        assert d["stages"][0]["delta_s"] == pytest.approx(2.4)
+        assert d["stages"][0]["ratio"] == pytest.approx(3.4, rel=1e-2)
+        # the unchanged stage stays below the noise floor
+        assert all(s["path"] != "pipe/fold" for s in d["stages"])
+        assert d["counters"] == {}  # identical counters -> no noise
+        assert d["knob_drift"] == {} and d["backend_drift"] is None
+
+    def test_flags_knob_numeric_and_backend_drift(self):
+        a = _synthetic("run-a", 1.0, {"scan": 0.8},
+                       knobs_set={"CRIMP_TPU_POLY_TRIG": "1"},
+                       numeric_mode={"trig": "poly"}, backend="tpu")
+        b = _synthetic("run-b", 1.0, {"scan": 0.8},
+                       knobs_set={"CRIMP_TPU_POLY_TRIG": "0",
+                                  "CRIMP_TPU_GRID_MXU": "1"},
+                       numeric_mode={"trig": "hw"}, backend="cpu")
+        d = report.diff(a, b)
+        assert d["knob_drift"]["CRIMP_TPU_POLY_TRIG"] == {"a": "1", "b": "0"}
+        assert d["knob_drift"]["CRIMP_TPU_GRID_MXU"] == {"a": None, "b": "1"}
+        assert d["numeric_mode_drift"] == {
+            "trig": {"a": "poly", "b": "hw"}}
+        assert d["backend_drift"] == {"a": "tpu", "b": "cpu"}
+        text = report.render_diff(d)
+        assert "KNOB DRIFT" in text
+        assert "NUMERIC-MODE DRIFT" in text
+        assert "BACKEND DRIFT" in text
+
+    def test_counter_deltas(self):
+        a = _synthetic("run-a", 1.0, {}, counters={"autotune_cache_hits": 4})
+        b = _synthetic("run-b", 1.0, {}, counters={"autotune_cache_hits": 1,
+                                                   "guard_trips": 2})
+        d = report.diff(a, b)
+        assert d["counters"]["autotune_cache_hits"]["delta"] == -3
+        assert d["counters"]["guard_trips"] == {"a": 0, "b": 2, "delta": 2}
+
+
+class TestExports:
+    def test_chrome_trace_events(self):
+        doc = _synthetic("run-a", 2.0, {"fold": 0.5},
+                         counters={"events_folded": 9})
+        trace = report.chrome_trace(doc)
+        complete = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert {e["name"] for e in complete} == {"pipe", "fold"}
+        fold = next(e for e in complete if e["name"] == "fold")
+        assert fold["dur"] == pytest.approx(0.5e6)
+        assert any(e["ph"] == "C" and e["name"] == "events_folded"
+                   for e in trace["traceEvents"])
+
+    def test_prometheus_exposition(self):
+        doc = _synthetic("run-a", 2.0, {"fold": 0.5},
+                         counters={"events_folded": 9})
+        text = report.prometheus(doc)
+        assert 'crimp_tpu_run_wall_seconds{run="run-a"} 2.0' in text
+        assert 'crimp_tpu_counter_total{run="run-a",name="events_folded"} 9' \
+            in text
+        assert 'path="pipe/fold"' in text
+
+    def test_summary_text(self):
+        doc = _synthetic("run-a", 2.0, {"fold": 0.5},
+                         knobs_set={"CRIMP_TPU_OBS": "1"},
+                         counters={"events_folded": 9})
+        text = report.summarize(doc)
+        assert "run-a" in text and "pipe/fold" in text
+        assert "events_folded" in text and "CRIMP_TPU_OBS=1" in text
+
+
+class TestCli:
+    def _manifests(self, tmp_path):
+        a = _synthetic("run-a", 1.0, {"scan": 0.8},
+                       knobs_set={"CRIMP_TPU_POLY_TRIG": "1"})
+        b = _synthetic("run-b", 2.0, {"scan": 1.8},
+                       knobs_set={"CRIMP_TPU_POLY_TRIG": "0"})
+        pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+        pa.write_text(json.dumps(a))
+        pb.write_text(json.dumps(b))
+        return str(pa), str(pb)
+
+    def test_summary_and_validate_ok(self, tmp_path, capsys):
+        pa, _ = self._manifests(tmp_path)
+        assert cli.main(["summary", pa]) == 0
+        assert "run-a" in capsys.readouterr().out
+        assert cli.main(["validate", pa]) == 0
+
+    def test_diff_fail_on_drift(self, tmp_path, capsys):
+        pa, pb = self._manifests(tmp_path)
+        assert cli.main(["diff", pa, pb]) == 0  # drift reported, not fatal
+        assert "KNOB DRIFT" in capsys.readouterr().out
+        assert cli.main(["diff", pa, pb, "--fail-on-drift"]) == 1
+        assert cli.main(["diff", pa, pa, "--fail-on-drift"]) == 0
+
+    def test_validate_flags_problems(self, tmp_path, capsys):
+        bad = tmp_path / "bad.json"
+        doc = _synthetic("run-x", 1.0, {})
+        doc.pop("spans")
+        bad.write_text(json.dumps(doc))
+        assert cli.main(["validate", str(bad)]) == 1
+        assert cli.main(["summary", str(bad)]) == 2  # load refuses, I/O exit
+        capsys.readouterr()
+
+    def test_missing_file_exits_2(self, tmp_path, capsys):
+        assert cli.main(["summary", str(tmp_path / "nope.json")]) == 2
+        capsys.readouterr()
+
+    def test_module_entry_point_smoke(self, tmp_path):
+        """python -m crimp_tpu.obs must work as a subprocess (the shape
+        scripts/obs_report.sh invokes) without initializing a backend."""
+        pa, pb = self._manifests(tmp_path)
+        import os
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        proc = subprocess.run(
+            [sys.executable, "-m", "crimp_tpu.obs", "diff", pa, pb],
+            cwd=str(REPO), env=env, capture_output=True, text=True,
+            timeout=120)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "stage attribution" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# profiling shim regressions
+# ---------------------------------------------------------------------------
+
+
+class TestProfilingForce:
+    def test_force_namedtuple_regression(self):
+        """force() on a namedtuple used to call type(result)(generator) —
+        a TypeError, since namedtuple constructors take fields
+        positionally."""
+        Pt = collections.namedtuple("Pt", "x y")
+        out = profiling.force(Pt(x=jax.numpy.arange(3), y=2.0))
+        assert isinstance(out, Pt)
+        np.testing.assert_array_equal(out.x, [0, 1, 2])
+        assert out.y == 2.0
+
+    def test_force_plain_containers_still_work(self):
+        out = profiling.force({"a": [jax.numpy.ones(2), (3.0,)]})
+        np.testing.assert_array_equal(out["a"][0], [1.0, 1.0])
+        assert isinstance(out["a"][1], tuple)
