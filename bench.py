@@ -120,6 +120,9 @@ def choose_platform(probe_timeout_s: float = 300.0) -> str:
     attempt = 0
     probed_with_port_closed = False
     cpu_no_relay_streak = 0
+    poll_n = 0
+    poll_t0 = None
+    next_poll_log = 1
     while True:
         port_open = relay_port_open(port)
         # Port-closed short-circuit: skip the expensive probe — but verify
@@ -129,8 +132,18 @@ def choose_platform(probe_timeout_s: float = 300.0) -> str:
         if not port_open and probed_with_port_closed and cpu_no_relay_streak == 0:
             if time.monotonic() >= deadline:
                 break
-            log(f"[bench] relay port {port} closed; polling "
-                f"({int(deadline - time.monotonic())}s to deadline)")
+            # Log on a power-of-two schedule (polls 1, 2, 4, 8, ...): the
+            # r5 record's tail was ~50 identical polling lines that buried
+            # every useful diagnostic. The summary line below still
+            # reports the full count + elapsed when the wait gives up.
+            poll_n += 1
+            if poll_t0 is None:
+                poll_t0 = time.monotonic()
+            if poll_n >= next_poll_log:
+                next_poll_log *= 2
+                log(f"[bench] relay port {port} closed; polling "
+                    f"(poll {poll_n}, "
+                    f"{int(deadline - time.monotonic())}s to deadline)")
             time.sleep(min(30.0, max(1.0, deadline - time.monotonic())))
             continue
         attempt += 1
@@ -176,6 +189,9 @@ def choose_platform(probe_timeout_s: float = 300.0) -> str:
         if time.monotonic() >= deadline:
             break
         time.sleep(min(retry_wait, max(1.0, deadline - time.monotonic())))
+    if poll_n:
+        log(f"[bench] relay port {port} stayed closed: {poll_n} poll(s) "
+            f"over {time.monotonic() - poll_t0:.0f}s")
     log(f"[bench] no accelerator within the {deadline_s:.0f}s probe deadline")
     return "cpu"
 
@@ -921,10 +937,16 @@ def main():
     cfg4_events = scaled(1_000 if on_cpu else 2_000, 200)
 
     errors: dict[str, str] = {}
+    # the step() call sites below, in order — heartbeat denominators
+    n_stages = 8  # surrogate warmup z2 grid_mxu delta_fold toas north_star config4
+    stages_done = [0]
 
     def step(name: str, fn, *args, **kwargs):
         """Run one sub-measurement; a failure records the error and moves
         on so the final record carries every measurement that DID finish."""
+        # stage boundaries are forced heartbeats: a wedged bench tails as
+        # "bench:<stage>" with stages-done progress rather than silence
+        obs.beat(stages_done[0], n_stages, label=f"bench:{name}", force=True)
         try:
             out = fn(*args, **kwargs)
             emit_partial(name, out if isinstance(out, dict) else {"ok": True})
@@ -935,6 +957,8 @@ def main():
             log(traceback.format_exc())
             emit_partial(name, {"error": errors[name]})
             return None
+        finally:
+            stages_done[0] += 1
 
     log("[bench] building synthetic merged-campaign surrogate ...")
     built = step("surrogate", build_surrogate, par, intervals_path, template,
@@ -951,6 +975,9 @@ def main():
         }
         emit_partial("final", record)
         print(json.dumps(record), flush=True)
+        from crimp_tpu.obs import ledger as obs_ledger
+
+        obs_ledger.append_bench_record(record, source="bench.py")
         return
     times, intervals = built
     log(f"[bench] surrogate: {len(times)} events over {len(intervals)} intervals")
@@ -1008,6 +1035,7 @@ def main():
 
     # close the flight-recorder run first so the manifest the record points
     # at is already on disk (atomic) when the record line hits stdout
+    obs.beat(stages_done[0], n_stages, label="bench:done", force=True)
     _obs_stack.close()
     record = {
         "metric": "toa_extraction_throughput_84toa_res1000",
@@ -1080,6 +1108,14 @@ def main():
     # stderr); flushed so an external kill right after this line cannot
     # leave the official record stuck in a stdio buffer
     print(json.dumps(record), flush=True)
+    # end-of-round ledger hook: when CRIMP_TPU_OBS_LEDGER points at a
+    # JSONL path, the round's record lands there classified and
+    # baseline-comparable (obs ledger check gates it in CI)
+    from crimp_tpu.obs import ledger as obs_ledger
+
+    ledger_path = obs_ledger.append_bench_record(record, source="bench.py")
+    if ledger_path:
+        log(f"[bench] ledger: round record appended to {ledger_path}")
 
 
 if __name__ == "__main__":

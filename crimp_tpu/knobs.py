@@ -135,6 +135,14 @@ REGISTRY: dict[str, Knob] = _build_registry((
     Knob("CRIMP_TPU_OBS_EVENTS", "on (when obs is on)", "bool",
          consumer="crimp_tpu/obs",
          doc="append-only JSONL event stream alongside the manifest"),
+    Knob("CRIMP_TPU_OBS_HEARTBEAT_S", "30 (when obs is on)", "float",
+         consumer="crimp_tpu/obs/heartbeat.py",
+         doc="heartbeat period: progress/ETA events + an atomically "
+             "rewritten sidecar; 0/off disables"),
+    Knob("CRIMP_TPU_OBS_LEDGER", "unset (off)", "path",
+         consumer="bench.py + crimp_tpu/obs/ledger.py",
+         doc="append-only performance-ledger JSONL; bench.py appends its "
+             "round record there at end of run"),
     # -- bench --------------------------------------------------------------
     Knob("CRIMP_TPU_BENCH_PLATFORM", "unset", "str", consumer="bench.py",
          doc="skip the bench's relay platform probe and label records with this"),

@@ -15,6 +15,13 @@ Three pieces (docs/observability.md has the full contracts):
   crimp_tpu.obs``): summarize a manifest, diff two runs (span-level
   slowdown attribution, counter deltas, knob drift), export Chrome
   trace-event JSON and Prometheus text exposition.
+- **Live + longitudinal layer**: :mod:`crimp_tpu.obs.heartbeat`
+  (periodic progress/ETA events + an atomic sidecar, the default
+  ``progress`` of long scans), :mod:`crimp_tpu.obs.salvage`
+  (``obs salvage`` reconstructs a manifest from a killed run's event
+  stream; ``obs tail`` follows a live one) and
+  :mod:`crimp_tpu.obs.ledger` (``obs ledger add|show|check``: classify
+  bench records, compute the green on-chip baseline, gate regressions).
 
 Everything here is host-side by construction: graftlint GL001 flags any
 call into this package reachable from traced code. Disabled
@@ -41,3 +48,5 @@ from crimp_tpu.obs.core import (  # noqa: F401
     run,
     span,
 )
+from crimp_tpu.obs import heartbeat  # noqa: F401
+from crimp_tpu.obs.heartbeat import beat  # noqa: F401

@@ -7,9 +7,14 @@ Subcommands:
 - ``trace MANIFEST [-o OUT]`` export Chrome trace-event JSON (Perfetto)
 - ``prom MANIFEST [-o OUT]``  export Prometheus text exposition
 - ``validate MANIFEST``       schema-check a manifest
+- ``salvage EVENTS``          reconstruct a manifest from a killed run's
+                              event stream (``"salvaged": true``)
+- ``tail TARGET``             follow a live event stream (progress/ETA)
+- ``ledger add|show|check``   the append-only performance ledger
 
 Exit codes: 0 = ok, 1 = validation problems / drift found with
-``--fail-on-drift``, 2 = usage or I/O error.
+``--fail-on-drift`` / regression with ``--fail-on-regression`` / tail
+without a run end, 2 = usage or I/O error.
 """
 
 from __future__ import annotations
@@ -18,7 +23,9 @@ import argparse
 import json
 import sys
 
+from crimp_tpu.obs import ledger as ldg
 from crimp_tpu.obs import report as rpt
+from crimp_tpu.obs import salvage as slv
 from crimp_tpu.obs.manifest import load_manifest, validate_manifest
 
 
@@ -52,7 +59,88 @@ def build_parser() -> argparse.ArgumentParser:
 
     v = sub.add_parser("validate", help="schema-check a manifest")
     v.add_argument("manifest")
+
+    sv = sub.add_parser(
+        "salvage", help="reconstruct a best-effort manifest from a killed "
+                        "run's event stream")
+    sv.add_argument("events", help="*.events.jsonl file or a run directory "
+                                   "(newest stream wins)")
+    sv.add_argument("-o", "--out", default=None,
+                    help="output path (default: <run>.salvaged.manifest.json "
+                         "next to the stream)")
+
+    tl = sub.add_parser("tail", help="follow a live event stream, rendering "
+                                     "progress/ETA heartbeats")
+    tl.add_argument("target", help="run directory or *.events.jsonl file")
+    tl.add_argument("--once", action="store_true",
+                    help="render what is there and exit (0 only if the run "
+                         "already ended)")
+    tl.add_argument("--interval", type=float, default=2.0,
+                    help="poll period in seconds")
+    tl.add_argument("--max-seconds", type=float, default=None,
+                    help="give up (exit 1) after this long without run_end")
+
+    lg = sub.add_parser("ledger", help="append-only performance ledger: "
+                                       "classify records, baseline, gate")
+    lg.add_argument("action", choices=("add", "show", "check"))
+    lg.add_argument("paths", nargs="*",
+                    help="bench records (BENCH_r*.json), bench logs, or obs "
+                         "manifests to ingest")
+    lg.add_argument("--ledger", default=None,
+                    help="ledger JSONL path (default: $CRIMP_TPU_OBS_LEDGER)")
+    lg.add_argument("--format", choices=("text", "json"), default="text")
+    lg.add_argument("--tolerance-pct", type=float, default=5.0,
+                    help="regression tolerance band per metric")
+    lg.add_argument("--fail-on-regression", action="store_true",
+                    help="exit 1 when the latest green entry regresses")
     return p
+
+
+def _ledger_entries(args) -> tuple[list[dict], str | None]:
+    """Entries for a ledger action: stored ledger rows + listed artifacts."""
+    path = args.ledger if args.ledger is not None else ldg.env_ledger_path()
+    entries = ldg.read(path) if path else []
+    for src in args.paths:
+        entries.extend(ldg.entries_from_path(src))
+    return entries, path
+
+
+def _cmd_ledger(args) -> int:
+    if args.action == "add":
+        path = args.ledger if args.ledger is not None else ldg.env_ledger_path()
+        if not path:
+            print("obs ledger add: no ledger path (--ledger or "
+                  "CRIMP_TPU_OBS_LEDGER)", file=sys.stderr)
+            return 2
+        if not args.paths:
+            print("obs ledger add: nothing to ingest", file=sys.stderr)
+            return 2
+        entries = []
+        for src in args.paths:
+            entries.extend(ldg.entries_from_path(src))
+        ldg.append(path, entries)
+        print(f"appended {len(entries)} entrie(s) to {path}")
+        return 0
+    entries, _ = _ledger_entries(args)
+    if args.action == "show":
+        doc = {"entries": entries, "baseline": ldg.baseline(entries)}
+        if args.format == "json":
+            print(json.dumps(doc, indent=2))
+        else:
+            for e in entries:
+                rnd = f"r{e.get('round')}" if e.get("round") is not None \
+                    else "r?"
+                print(f"{rnd:<4} {e.get('class', '?'):<13} "
+                      f"{e.get('kind', '?'):<13} {e.get('source', '?')}")
+            for metric, b in sorted(doc["baseline"].items()):
+                print(f"baseline {metric:<24} {b['value']:<12g} {b['source']}")
+        return 0
+    report = ldg.check(entries, tolerance_pct=args.tolerance_pct)
+    if args.format == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        print(ldg.render_check(report))
+    return 1 if (args.fail_on_regression and not report["ok"]) else 0
 
 
 def _write(text: str, out: str | None) -> None:
@@ -110,6 +198,22 @@ def main(argv: list[str] | None = None) -> int:
             doc = load_manifest(args.manifest)
             _write(rpt.prometheus(doc), args.out)
             return 0
+
+        if args.cmd == "salvage":
+            events = slv.resolve_events(args.events)
+            out = slv.salvage_file(events, args.out)
+            doc = load_manifest(out)  # a salvage that fails validation is a bug
+            print(out)
+            print(rpt.summarize(doc), file=sys.stderr)
+            return 0
+
+        if args.cmd == "tail":
+            return slv.tail(args.target, follow=not args.once,
+                            interval=args.interval,
+                            max_seconds=args.max_seconds)
+
+        if args.cmd == "ledger":
+            return _cmd_ledger(args)
     except (OSError, ValueError) as exc:
         print(f"obs: {exc}", file=sys.stderr)
         return 2

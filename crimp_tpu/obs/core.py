@@ -11,10 +11,12 @@ Design constraints (enforced by tests/test_obs.py):
   producer threads; all registry mutation happens under one re-entrant
   lock and span parentage is tracked per-thread, so concurrent stages
   record correctly instead of racing a bare dict.
-- **Crash-durable.** When events are on, every span/counter event is
-  appended (and flushed) to a JSONL stream as it happens; the manifest
-  is written atomically (tmp + rename) at run end, so a killed run still
-  leaves a readable flight record.
+- **Crash-durable.** When events are on, every span open/close, counter,
+  gauge and heartbeat event is appended (and flushed) to a JSONL stream
+  as it happens, each stamped with a run-relative monotonic ``t_s``; the
+  manifest is written atomically (tmp + rename) at run end. A killed run
+  therefore leaves enough on disk for ``obs salvage`` to reconstruct a
+  best-effort manifest (see :mod:`crimp_tpu.obs.salvage`).
 - **Host-side by construction.** Never imports jax at module level and
   never initializes a backend: platform identity is probed only from
   backends some *other* code already brought up. graftlint GL001 bans
@@ -118,6 +120,9 @@ class Span:
             self.index = len(rec.spans)
             rec.spans.append(self._row)
         stack.append(self.index)
+        rec._emit({"ev": "span_open", "i": self.index,
+                   **{k: self._row[k] for k in
+                      ("name", "kind", "t0_s", "parent", "thread")}})
 
     def set(self, **attrs):
         """Attach attributes to the span while it is open."""
@@ -170,14 +175,19 @@ class RunRecorder:
         }]
         self._threads: dict[int, int] = {threading.get_ident(): 0}
         self._events = None
+        self.hb = None  # lazy per-run heartbeat state (obs/heartbeat.py)
         os.makedirs(self.dir, exist_ok=True)
         if knobs.env_onoff("CRIMP_TPU_OBS_EVENTS") is not False:
             path = os.path.join(self.dir, self.run_id + ".events.jsonl")
             self._events = open(path, "a", encoding="utf-8")
+        # The knob snapshot rides in run_start so a salvaged manifest can
+        # carry the same environment record a finalized one does.
         self._emit({"ev": "run_start", "schema": OBS_SCHEMA,
                     "schema_version": OBS_SCHEMA_VERSION,
                     "run_id": self.run_id, "name": self.name,
-                    "t_start_unix": round(self.t0_unix, 3)})
+                    "t_start_unix": round(self.t0_unix, 3),
+                    "knobs": _knob_snapshot(),
+                    "attrs": dict(attrs)})
 
     def _thread_ordinal(self) -> int:
         ident = threading.get_ident()
@@ -190,6 +200,7 @@ class RunRecorder:
         with _LOCK:
             if self._events is None:  # closed by finalize on another thread
                 return
+            event.setdefault("t_s", round(time.perf_counter() - self.t0, 6))
             json.dump(event, self._events, default=str)
             self._events.write("\n")
             self._events.flush()
@@ -366,6 +377,7 @@ def counter_add(name: str, value: float = 1) -> None:
         return
     with _LOCK:
         rec.counters[name] = rec.counters.get(name, 0) + value
+    rec._emit({"ev": "ctr", "k": str(name), "v": value})
 
 
 def gauge_set(name: str, value: float) -> None:
@@ -375,6 +387,7 @@ def gauge_set(name: str, value: float) -> None:
         return
     with _LOCK:
         rec.gauges[name] = value
+    rec._emit({"ev": "gauge", "k": str(name), "v": value})
 
 
 def record_numeric_mode(mode: dict) -> None:
@@ -384,3 +397,4 @@ def record_numeric_mode(mode: dict) -> None:
         return
     with _LOCK:
         rec.numeric_mode = json.loads(json.dumps(mode, default=str))
+    rec._emit({"ev": "numeric_mode", "mode": rec.numeric_mode})

@@ -55,6 +55,15 @@ def validate_manifest(doc) -> list[str]:
             problems.append(
                 f"{field!r} is {type(doc[field]).__name__}, expected "
                 + "/".join(t.__name__ for t in types))
+    # optional extensions (salvaged reconstructions carry these)
+    if "salvaged" in doc and not isinstance(doc["salvaged"], bool):
+        problems.append(
+            f"'salvaged' is {type(doc['salvaged']).__name__}, expected bool")
+    if "heartbeat" in doc and not isinstance(doc["heartbeat"],
+                                             (dict, type(None))):
+        problems.append(
+            f"'heartbeat' is {type(doc['heartbeat']).__name__}, "
+            "expected object/null")
     if doc.get("schema") not in (None, OBS_SCHEMA):
         problems.append(f"schema is {doc.get('schema')!r}, expected {OBS_SCHEMA!r}")
     ver = doc.get("schema_version")
@@ -115,7 +124,7 @@ def span_paths(doc: dict) -> list[str]:
     The path is the diff key: two runs of the same pipeline produce the
     same paths for the same stages regardless of absolute timing.
     """
-    spans = doc["spans"]
+    spans = doc.get("spans") or []
     paths: list[str] = []
     for i, row in enumerate(spans):
         parent = row.get("parent")

@@ -14,10 +14,15 @@ import json
 from crimp_tpu.obs.manifest import span_paths
 
 
+def _sec(val) -> str:
+    """Seconds for humans; '?' for a partial doc's missing/null field."""
+    return f"{val:.3f}s" if isinstance(val, (int, float)) else "?"
+
+
 def span_rollup(doc: dict) -> dict[str, dict]:
     """Aggregate span durations by path: path -> {sum_s, count, kind}."""
     out: dict[str, dict] = {}
-    for path, row in zip(span_paths(doc), doc["spans"]):
+    for path, row in zip(span_paths(doc), doc.get("spans") or []):
         dur = row.get("dur_s")
         if dur is None:
             continue
@@ -32,10 +37,14 @@ def span_rollup(doc: dict) -> dict[str, dict]:
 def summarize(doc: dict, top: int = 12) -> str:
     """Human-readable one-run summary (the ``summary`` subcommand)."""
     plat = doc.get("platform") or {}
-    lines = [
-        f"run      {doc['run_id']}",
-        f"name     {doc['name']}",
-        f"wall     {doc['wall_s']:.3f}s"
+    lines = []
+    if doc.get("salvaged"):
+        lines.append("SALVAGED reconstructed from the event stream of a "
+                     "killed run; durations are lower bounds")
+    lines += [
+        f"run      {doc.get('run_id') or '?'}",
+        f"name     {doc.get('name') or '?'}",
+        f"wall     {_sec(doc.get('wall_s'))}"
         + (f"   ERROR: {doc['error']}" if doc.get("error") else ""),
         f"backend  {plat.get('backend') or 'none initialized'}"
         f"  devices={len(plat.get('devices') or [])}",
@@ -47,7 +56,7 @@ def summarize(doc: dict, top: int = 12) -> str:
         lines.append(f"knobs    {len(snap)} set: "
                      + " ".join(f"{k}={v}" for k, v in sorted(snap.items())))
     rollup = span_rollup(doc)
-    rollup.pop(doc["name"], None)  # the root just restates wall_s
+    rollup.pop(doc.get("name"), None)  # the root just restates wall_s
     if rollup:
         lines.append(f"spans    ({min(top, len(rollup))} of {len(rollup)} paths by total time)")
         ranked = sorted(rollup.items(), key=lambda kv: -kv[1]["sum_s"])
@@ -88,8 +97,8 @@ def diff(a: dict, b: dict, min_delta_s: float = 0.005) -> dict:
     ra, rb = span_rollup(a), span_rollup(b)
     # the root span just restates wall_s (reported separately) — left in,
     # it would always outrank the actual per-stage attribution
-    ra.pop(a["name"], None)
-    rb.pop(b["name"], None)
+    ra.pop(a.get("name"), None)
+    rb.pop(b.get("name"), None)
     stages = []
     for path in sorted(set(ra) | set(rb)):
         sa = ra.get(path, {}).get("sum_s", 0.0)
@@ -133,10 +142,14 @@ def diff(a: dict, b: dict, min_delta_s: float = 0.005) -> dict:
 
     pa = (a.get("platform") or {}).get("backend")
     pb = (b.get("platform") or {}).get("backend")
+    wa, wb = a.get("wall_s"), b.get("wall_s")
+    both_walls = all(isinstance(w, (int, float)) for w in (wa, wb))
     return {
-        "a": a["run_id"], "b": b["run_id"],
-        "wall_a_s": a["wall_s"], "wall_b_s": b["wall_s"],
-        "wall_delta_s": _round6(b["wall_s"] - a["wall_s"]),
+        "a": a.get("run_id") or "?", "b": b.get("run_id") or "?",
+        "wall_a_s": wa, "wall_b_s": wb,
+        "wall_delta_s": _round6(wb - wa) if both_walls else None,
+        "salvaged": ({"a": bool(a.get("salvaged")), "b": bool(b.get("salvaged"))}
+                     if (a.get("salvaged") or b.get("salvaged")) else None),
         "backend_drift": None if pa == pb else {"a": pa, "b": pb},
         "stages": stages,
         "counters": counters,
@@ -151,11 +164,16 @@ def _round6(val):
 
 def render_diff(d: dict, top: int = 12) -> str:
     """Human-readable rendering of a :func:`diff` result."""
+    delta = d["wall_delta_s"]
+    delta_txt = f"{delta:+.3f}s" if isinstance(delta, (int, float)) else "?"
     lines = [
-        f"A  {d['a']}   wall {d['wall_a_s']:.3f}s",
-        f"B  {d['b']}   wall {d['wall_b_s']:.3f}s   "
-        f"delta {d['wall_delta_s']:+.3f}s",
+        f"A  {d['a']}   wall {_sec(d['wall_a_s'])}",
+        f"B  {d['b']}   wall {_sec(d['wall_b_s'])}   delta {delta_txt}",
     ]
+    if d.get("salvaged"):
+        which = "+".join(k.upper() for k in ("a", "b") if d["salvaged"][k])
+        lines.append(f"SALVAGED {which}  (killed-run reconstruction; "
+                     "durations are lower bounds)")
     if d["backend_drift"]:
         lines.append(f"BACKEND DRIFT  {d['backend_drift']['a']} -> "
                      f"{d['backend_drift']['b']}")

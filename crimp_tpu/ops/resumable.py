@@ -404,6 +404,7 @@ class ResumableScan:
                 np.save(fh, rows)
             tmp.rename(self._chunk_path(i))
         parts[i] = rows
+        obs.counter_add("chunks_computed", 1)
         if progress is not None:
             progress(i, self.n_chunks)
 
@@ -425,7 +426,16 @@ class ResumableScan:
             obs.record_numeric_mode(self._numeric_mode)
             done = set(self.done_chunks())
             obs.counter_add("chunks_resumed", len(done))
-            obs.counter_add("chunks_computed", self.n_chunks - len(done))
+            # seeded at 0 and incremented per checkpointed chunk in
+            # _finish_chunk, so a killed run's salvaged manifest counts
+            # the chunks that actually finished
+            obs.counter_add("chunks_computed", 0)
+            # heartbeats (progress/ETA events + the atomic sidecar) are
+            # the default progress consumer; the caller's own callback
+            # chains after each beat with the documented (i, n) signature
+            progress = obs.heartbeat.scan_progress(
+                base=len(done), total=self.n_chunks,
+                label=f"{self.statistic}_chunks", echo=progress)
             parts: list[np.ndarray | None] = [None] * self.n_chunks
             pending: tuple[int, object] | None = None
             with obs.span("chunk_loop", kind="stage"):

@@ -909,11 +909,16 @@ def fit_toas_bucketed(
         pending.append((bucket, res))
     # Pass 2 — materialize: np.asarray blocks on each device buffer in
     # dispatch order and scatters back to the original segment order.
+    # Each drained bucket is a heartbeat boundary: this is where a long
+    # ToA extraction actually waits on the device, so progress/ETA here
+    # tracks real completion rather than async dispatch.
     out: dict[str, np.ndarray] = {}
-    for bucket, res in pending:
+    for b_done, (bucket, res) in enumerate(pending):
+        obs.beat(b_done, len(pending), label="toa_buckets")
         for key, val in res.items():
             arr = np.asarray(val)
             if key not in out:
                 out[key] = np.zeros((len(phase_list),) + arr.shape[1:], dtype=arr.dtype)
             out[key][bucket] = arr
+    obs.beat(len(pending), len(pending), label="toa_buckets", force=True)
     return out

@@ -8,9 +8,14 @@
 #   bash scripts/obs_report.sh trace    obs_runs/<run>.json -o out.json
 #   bash scripts/obs_report.sh prom     obs_runs/<run>.json
 #   bash scripts/obs_report.sh validate obs_runs/<run>.json
+#   bash scripts/obs_report.sh tail     obs_runs [--once]
+#   bash scripts/obs_report.sh salvage  obs_runs/<run>.events.jsonl
+#   bash scripts/obs_report.sh ledger   check BENCH_r*.json \
+#       --fail-on-regression --tolerance-pct 5
 #
-# Exit codes: 0 ok, 1 drift (diff --fail-on-drift) / invalid manifest,
-# 2 usage or I/O error.
+# Exit codes: 0 ok, 1 drift (diff --fail-on-drift) / invalid manifest /
+# regression (ledger check --fail-on-regression) / tail without a run
+# end, 2 usage or I/O error.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."

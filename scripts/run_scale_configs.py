@@ -40,6 +40,20 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def chunk_echo(tag: str):
+    """The per-chunk status line for a checkpointed scan.
+
+    ResumableScan.run() chains this AFTER its obs heartbeat default, so
+    with CRIMP_TPU_OBS on a scale run records progress/ETA (heartbeat
+    events + the atomic sidecar ``obs tail`` follows) and still prints
+    the same line it always did.
+    """
+    def echo(i: int, n: int) -> None:
+        log(f"[{tag}] chunk {i + 1}/{n} done")
+
+    return echo
+
+
 def centered_freq_grid(span_s: float, n_freq: int) -> np.ndarray:
     """Trial grid centered exactly on F0 with spacing 1/(2T) — trial spacing
     must resolve the Fourier width 1/T (2x oversampled) or the injection
@@ -143,8 +157,7 @@ def config3(scale: float, checkpoint: str | None = None) -> dict:
         )
         extra = {"resumed_chunks": len(scan.done_chunks()),
                  "total_chunks": scan.n_chunks}
-        power_2d = scan.run(
-            progress=lambda i, n: log(f"[config3] chunk {i + 1}/{n} done"))
+        power_2d = scan.run(progress=chunk_echo("config3"))
         wall = time.perf_counter() - t0
         i_fd, i_f = np.unravel_index(np.argmax(power_2d), power_2d.shape)
         peak = (freqs[i_f], log_fdots[i_fd], power_2d[i_fd, i_f])
@@ -198,8 +211,7 @@ def config5(scale: float, checkpoint: str | None = None) -> dict:
         )
         extra = {"resumed_chunks": len(scan.done_chunks()),
                  "total_chunks": scan.n_chunks}
-        power = scan.run(
-            progress=lambda i, n: log(f"[config5] chunk {i + 1}/{n} done"))
+        power = scan.run(progress=chunk_echo("config5"))
     else:
         ps = search.PeriodSearch(times, freqs, 20)  # blind: generous harmonics
         power = ps.htest()

@@ -219,6 +219,41 @@ class TestPlatformAcquisition:
         assert bench.choose_platform() == "cpu"
         assert calls["probes"] == 1
 
+    def test_polling_lines_are_rate_limited(self, monkeypatch):
+        """The r5 failure mode: ~50 identical 'polling' lines burying the
+        diagnostics. Log lines must follow the power-of-two schedule, with
+        one end-of-wait summary carrying the full poll count."""
+        import bench
+
+        monkeypatch.delenv("CRIMP_TPU_BENCH_PLATFORM", raising=False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        monkeypatch.setenv("CRIMP_TPU_BENCH_PROBE_DEADLINE_S", "300")
+        lines: list[str] = []
+        monkeypatch.setattr(bench, "log", lines.append)
+        monkeypatch.setattr(bench, "relay_port_open", lambda *a, **k: False)
+
+        clock = {"t": 0.0}
+        monkeypatch.setattr(bench.time, "monotonic", lambda: clock["t"])
+        # cap each sleep at the 30s poll cadence so the fake clock marches
+        # through the 300s deadline in poll-sized steps
+        monkeypatch.setattr(bench.time, "sleep",
+                            lambda s: clock.update(t=clock["t"] + min(s, 30.0)))
+
+        class FakeCompleted:
+            returncode = 1
+            stdout = ""
+            stderr = "probe exploded"
+
+        monkeypatch.setattr(bench.subprocess, "run",
+                            lambda *a, **k: FakeCompleted())
+        assert bench.choose_platform() == "cpu"
+        polling = [ln for ln in lines if "polling" in ln]
+        # 9 polls fit in the window; only polls 1, 2, 4, 8 may log
+        assert len(polling) == 4, polling
+        summary = [ln for ln in lines if "stayed closed" in ln]
+        assert len(summary) == 1
+        assert "9 poll(s)" in summary[0]
+
 
 class TestPartialSidecar:
     def test_emit_partial_appends_json_lines(self, monkeypatch, tmp_path):

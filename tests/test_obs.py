@@ -16,6 +16,7 @@ import pathlib
 import subprocess
 import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -23,7 +24,7 @@ import pytest
 jax = pytest.importorskip("jax")
 
 from crimp_tpu import obs  # noqa: E402
-from crimp_tpu.obs import cli, core, report  # noqa: E402
+from crimp_tpu.obs import cli, core, heartbeat, report, salvage  # noqa: E402
 from crimp_tpu.obs.manifest import (  # noqa: E402
     load_manifest,
     span_paths,
@@ -433,6 +434,286 @@ class TestCli:
             timeout=120)
         assert proc.returncode == 0, proc.stderr[-2000:]
         assert "stage attribution" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats: progress/ETA events + the atomic sidecar
+# ---------------------------------------------------------------------------
+
+
+class TestHeartbeat:
+    def test_beat_noop_when_disabled(self, obs_off):
+        assert obs.beat(1, 10, label="chunks") is None
+        assert not obs_off.exists(), "obs-off beat touched the filesystem"
+
+    def test_zero_period_disables_even_with_obs_on(self, obs_on, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", "0")
+        with obs.run("quiet"):
+            assert obs.beat(1, 2, force=True) is None
+        assert not list(obs_on.glob("*.heartbeat.json"))
+        stream = next(iter(obs_on.glob("*.events.jsonl")))
+        assert not any(json.loads(ln)["ev"] == "heartbeat"
+                       for ln in stream.read_text().splitlines())
+
+    def test_period_knob_parsing(self, monkeypatch):
+        monkeypatch.delenv("CRIMP_TPU_OBS_HEARTBEAT_S", raising=False)
+        assert heartbeat.period_s() == heartbeat.DEFAULT_PERIOD_S
+        monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", "off")
+        assert heartbeat.period_s() is None
+        monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", "2.5")
+        assert heartbeat.period_s() == 2.5
+        for bad in ("-1", "nan", "soon"):
+            monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", bad)
+            with pytest.raises(ValueError):
+                heartbeat.period_s()
+
+    def test_beat_emits_event_and_atomic_sidecar(self, obs_on, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", "0.0001")
+        with obs.run("hb") as rec:
+            with obs.span("stage_a"):
+                doc = obs.beat(3, 12, label="chunks")
+        assert doc["done"] == 3 and doc["total"] == 12
+        assert doc["frac"] == pytest.approx(0.25)
+        assert doc["span"] == "hb/stage_a"  # deepest open span path
+        sidecar = obs_on / f"{rec.run_id}.heartbeat.json"
+        assert json.loads(sidecar.read_text())["label"] == "chunks"
+        assert not list(obs_on.glob("*.heartbeat.json.tmp"))  # atomic
+        stream = obs_on / f"{rec.run_id}.events.jsonl"
+        hbs = [json.loads(ln) for ln in stream.read_text().splitlines()
+               if json.loads(ln)["ev"] == "heartbeat"]
+        assert len(hbs) == 1 and hbs[0]["done"] == 3
+        assert isinstance(hbs[0]["t_s"], float)  # monotonic run-relative
+
+    def test_rate_limited_until_forced(self, obs_on, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", "1000")
+        with obs.run("hb"):
+            assert obs.beat(1, 4) is not None  # first beat always lands
+            assert obs.beat(2, 4) is None      # inside the period: limited
+            assert obs.beat(3, 4, force=True) is not None
+
+    def test_eta_from_observed_rate_only(self, obs_on, monkeypatch):
+        """A resumable scan 'completing' restored chunks instantly must
+        not inflate the rate window (the first beat anchors it)."""
+        monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", "0.0001")
+        with obs.run("hb"):
+            first = obs.beat(50, 100, label="chunks")  # resumed base
+            assert first["rate_per_s"] is None  # no observed work yet
+            time.sleep(0.005)  # clear the (tiny) period + accrue dt
+            second = obs.beat(51, 100, label="chunks")
+        assert second["rate_per_s"] is not None and second["rate_per_s"] > 0
+        assert second["eta_s"] is not None and second["eta_s"] > 0
+
+    def test_scan_progress_chains_echo(self, obs_on, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", "0.0001")
+        seen = []
+        cb = heartbeat.scan_progress(base=1, total=3, label="chunks",
+                                     echo=lambda i, n: seen.append((i, n)))
+        with obs.run("hb") as rec:
+            cb(0, 2)
+            cb(1, 2)
+        assert seen == [(0, 2), (1, 2)]  # caller's callback untouched
+        hb = json.loads((obs_on / f"{rec.run_id}.heartbeat.json").read_text())
+        assert hb["done"] == 3 and hb["total"] == 3  # base + calls, forced
+
+    def test_resumable_scan_heartbeats_by_default(self, obs_on, monkeypatch,
+                                                  events, tmp_path):
+        monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", "0.0001")
+        ResumableScan(events, FREQS, nharm=2, store=str(tmp_path / "ck"),
+                      chunk_trials=150).run()
+        sidecar = list(obs_on.glob("*.heartbeat.json"))
+        assert len(sidecar) == 1
+        hb = json.loads(sidecar[0].read_text())
+        assert hb["done"] == 2 and hb["total"] == 2
+        assert hb["label"] == "z2_chunks"
+        stream = next(iter(obs_on.glob("*.events.jsonl")))
+        assert any(json.loads(ln)["ev"] == "heartbeat"
+                   for ln in stream.read_text().splitlines())
+
+
+# ---------------------------------------------------------------------------
+# Crash salvage: killed runs leave a diffable manifest
+# ---------------------------------------------------------------------------
+
+
+class TestSalvage:
+    def _killed_stream(self, obs_on, tmp_path):
+        """An event stream snapshotted mid-run: no run_end, open spans."""
+        import shutil
+        with obs.run("work") as rec:
+            obs.record_numeric_mode({"trig": "poly"})
+            with obs.span("stage_a"):
+                obs.counter_add("chunks_computed", 0)
+                obs.counter_add("chunks_computed", 3)
+                obs.gauge_set("pad_frac", 0.5)
+                src = obs_on / f"{rec.run_id}.events.jsonl"
+                snap = tmp_path / "killed.events.jsonl"
+                shutil.copy(src, snap)
+        return snap
+
+    def test_salvaged_manifest_validates_and_replays(self, obs_on, tmp_path):
+        snap = self._killed_stream(obs_on, tmp_path)
+        doc = salvage.salvage(str(snap))
+        assert validate_manifest(doc) == []
+        assert doc["salvaged"] is True
+        assert doc["counters"]["chunks_computed"] == 3
+        assert doc["gauges"]["pad_frac"] == 0.5
+        assert doc["numeric_mode"] == {"trig": "poly"}
+        assert doc["knobs"].get("CRIMP_TPU_OBS") == "1"  # from run_start
+        # the open span and the root both closed at the last event time
+        names = [(s["name"], s["dur_s"]) for s in doc["spans"]]
+        assert names[0][0] == "work" and names[1][0] == "stage_a"
+        assert all(isinstance(d, float) for _, d in names)
+        assert doc["wall_s"] >= doc["spans"][1]["dur_s"]
+
+    def test_torn_final_line_tolerated(self, obs_on, tmp_path):
+        snap = self._killed_stream(obs_on, tmp_path)
+        with open(snap, "a", encoding="utf-8") as fh:
+            fh.write('{"ev": "ctr", "k": "chunks_computed", "v": 99')  # torn
+        doc = salvage.salvage(str(snap))
+        assert doc["counters"]["chunks_computed"] == 3  # torn line dropped
+
+    def test_complete_stream_not_flagged_salvaged(self, obs_on):
+        with obs.run("fin"):
+            pass
+        stream = next(iter(obs_on.glob("*.events.jsonl")))
+        doc = salvage.salvage(str(stream))
+        assert doc["salvaged"] is False  # run_end present: a full record
+        assert validate_manifest(doc) == []
+
+    def test_cli_salvage_writes_validating_manifest(self, obs_on, tmp_path,
+                                                    capsys):
+        snap = self._killed_stream(obs_on, tmp_path)
+        assert cli.main(["salvage", str(snap)]) == 0
+        out_path = capsys.readouterr().out.strip()
+        assert out_path.endswith(".salvaged.manifest.json")
+        assert cli.main(["validate", out_path]) == 0
+        capsys.readouterr()
+
+    def test_sigkill_mid_scan_salvages_and_diffs(self, obs_on, events,
+                                                 tmp_path, monkeypatch):
+        """The acceptance e2e: SIGKILL a resumable scan mid-chunk, salvage
+        the stream, validate, check the replayed chunk counter, and diff
+        against a clean run of the same scan."""
+        import os
+        import signal  # noqa: F401 — used in the child script
+        child = (
+            "import os, signal\n"
+            "import numpy as np\n"
+            "from crimp_tpu.ops.resumable import ResumableScan\n"
+            "rng = np.random.RandomState(3)\n"
+            "times = np.sort(rng.uniform(0, 2000.0, 500))\n"
+            "freqs = np.linspace(0.14, 0.15, 40)\n"
+            "def prog(i, n):\n"
+            "    if i >= 1:\n"
+            "        os.kill(os.getpid(), signal.SIGKILL)\n"
+            f"ResumableScan(times, freqs, nharm=2, store={str(tmp_path / 'killed_store')!r},\n"
+            "              chunk_trials=10).run(progress=prog)\n"
+            "raise SystemExit('scan survived the kill')\n"
+        )
+        env = {**os.environ, "JAX_PLATFORMS": "cpu", "CRIMP_TPU_OBS": "1",
+               "CRIMP_TPU_OBS_DIR": str(obs_on),
+               "CRIMP_TPU_OBS_HEARTBEAT_S": "0.0001"}
+        proc = subprocess.run([sys.executable, "-c", child], cwd=str(REPO),
+                              env=env, capture_output=True, text=True,
+                              timeout=500)
+        assert proc.returncode == -9, (proc.returncode, proc.stderr[-2000:])
+        assert not list(obs_on.glob("*.manifest.json")), \
+            "a SIGKILLed run must not have finalized"
+        stream = next(iter(obs_on.glob("*.events.jsonl")))
+
+        out = salvage.salvage_file(str(stream))
+        doc = load_manifest(out)  # passes obs validate
+        assert doc["salvaged"] is True
+        # chunks 0 and 1 finished + checkpointed before the kill landed
+        assert doc["counters"]["chunks_computed"] == 2
+        assert doc["counters"]["chunks_resumed"] == 0
+        assert any(json.loads(ln)["ev"] == "heartbeat"
+                   for ln in stream.read_text().splitlines())
+
+        # a clean completed run of the same scan diffs against the salvage
+        rng = np.random.RandomState(3)
+        times = np.sort(rng.uniform(0, 2000.0, 500))
+        freqs = np.linspace(0.14, 0.15, 40)
+        ResumableScan(times, freqs, nharm=2,
+                      store=str(tmp_path / "clean_store"),
+                      chunk_trials=10).run()
+        clean = load_manifest(obs.last_manifest_path())
+        d = report.diff(doc, clean)
+        assert d["salvaged"] == {"a": True, "b": False}
+        assert d["counters"]["chunks_computed"]["delta"] == 2  # 2 -> 4
+        assert "SALVAGED" in report.render_diff(d)
+        assert cli.main(["diff", out, obs.last_manifest_path()]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Live tail
+# ---------------------------------------------------------------------------
+
+
+class TestTail:
+    def test_tail_once_renders_completed_run(self, obs_on, monkeypatch,
+                                             capsys):
+        monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", "0.0001")
+        with obs.run("tailed"):
+            with obs.span("stage_a"):
+                obs.beat(1, 2, label="chunks")
+        assert cli.main(["tail", str(obs_on), "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "run ended" in out
+        assert "[hb" in out and "1/2" in out
+
+    def test_tail_once_unfinished_run_exits_1(self, obs_on, tmp_path, capsys):
+        import shutil
+        with obs.run("unfinished") as rec:
+            src = obs_on / f"{rec.run_id}.events.jsonl"
+            snap = tmp_path / "live.events.jsonl"
+            shutil.copy(src, snap)
+        assert cli.main(["tail", str(snap), "--once"]) == 1
+        capsys.readouterr()
+
+    def test_tail_gives_up_after_max_seconds(self, obs_on, tmp_path, capsys):
+        import shutil
+        with obs.run("wedged") as rec:
+            src = obs_on / f"{rec.run_id}.events.jsonl"
+            snap = tmp_path / "wedged.events.jsonl"
+            shutil.copy(src, snap)
+        assert cli.main(["tail", str(snap), "--interval", "0.01",
+                         "--max-seconds", "0.05"]) == 1
+        assert "gave up" in capsys.readouterr().out
+
+    def test_tail_empty_dir_exits_2(self, tmp_path, capsys):
+        assert cli.main(["tail", str(tmp_path)]) == 2
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Partial/salvaged docs in the reporter
+# ---------------------------------------------------------------------------
+
+
+class TestPartialDocs:
+    def test_summarize_renders_placeholders_not_crashes(self):
+        # the in-progress shapes that used to raise KeyError/TypeError
+        partial = {"wall_s": None, "counters": {"x": 1}}
+        text = report.summarize(partial)
+        assert "run      ?" in text
+        assert "wall     ?" in text
+        assert report.span_rollup(partial) == {}
+
+    def test_salvaged_banner(self):
+        doc = _synthetic("run-s", 1.0, {"scan": 0.5})
+        doc["salvaged"] = True
+        text = report.summarize(doc)
+        assert text.splitlines()[0].startswith("SALVAGED")
+        assert "lower bounds" in text
+
+    def test_diff_with_missing_wall_renders_question_marks(self):
+        a = _synthetic("run-a", 1.0, {"scan": 0.5})
+        b = dict(_synthetic("run-b", 1.0, {"scan": 0.5}), wall_s=None)
+        d = report.diff(a, b)
+        assert d["wall_delta_s"] is None
+        text = report.render_diff(d)
+        assert "delta ?" in text
 
 
 # ---------------------------------------------------------------------------
