@@ -64,6 +64,14 @@ GRID_MXU_DEV_BUDGET = 0.01  # fraction of sqrt(4*nharm)
 DELTA_FOLD_SPEEDUP_GATE = 2.0
 DELTA_FOLD_DEV_FRAC = 0.01  # fraction of the 1 us per-ToA error bar
 
+# Promotion gate for the survey batch engine (ops/multisource.py): the
+# vmapped batched fold+H path must beat the per-source loop by >2x at a
+# batch of >=64 sources AND per-source results must be bitwise identical
+# (the bench uses equal per-source widths, so the exact-padding bitwise
+# contract applies with no tolerance). Only then does bench persist
+# multisource=1 for the workload bucket.
+MULTISOURCE_SPEEDUP_GATE = 2.0
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -714,6 +722,123 @@ def bench_delta_fold(par_path: str, times: np.ndarray, intervals,
     return out
 
 
+def bench_multisource(batch_sizes=(16, 64, 128), n_int: int = 4,
+                      events_per_int: int = 300, persist: bool = True) -> dict:
+    """Survey batch engine A/B: vmapped multi-source fold+H vs the
+    per-source loop, at several batch sizes, with the delta-fold-style
+    promotion gate (>2x at batch >=64 AND per-source bitwise parity).
+
+    The workload is dispatch-bound by construction — many small synthetic
+    pulsars (a few hundred events each), which is exactly the regime the
+    batched engine exists for: the loop pays per-source device round
+    trips, the batch amortizes them across the stacked source axis. Every
+    source uses the same per-interval event count, so the exact-padding
+    bitwise contract applies and parity is asserted with array_equal, no
+    tolerance. The gated verdict persists through
+    autotune.store_multisource for the (batch, width) workload bucket."""
+    from crimp_tpu.models import timing
+    from crimp_tpu.ops import anchored, autotune, multisource, search
+    from crimp_tpu.ops.ephem import spin_frequency_host
+
+    rng = np.random.RandomState(13)
+    edges = np.linspace(58000.0, 58008.0, n_int + 1)
+
+    def make_source(i):
+        tm = timing.from_dict({"PEPOCH": 58000.0,
+                               "F0": 0.1 + 0.002 * (i % 97), "F1": -1e-13})
+        segs = [np.sort(rng.uniform(lo + 1e-6, hi - 1e-6, events_per_int))
+                for lo, hi in zip(edges[:-1], edges[1:])]
+        return tm, segs
+
+    sources = [make_source(i) for i in range(max(batch_sizes))]
+
+    def batched(tms, seg_lists):
+        phase_lists, t_refs = multisource.fold_sources(tms, seg_lists)
+        freqs_list = [spin_frequency_host(tm, tr)[0]
+                      for tm, tr in zip(tms, t_refs)]
+        h_list = multisource.h_power_sources(seg_lists, freqs_list)
+        return phase_lists, h_list
+
+    def looped(tms, seg_lists):
+        phs, hs = [], []
+        for tm, segs in zip(tms, seg_lists):
+            pl, mids = anchored.fold_segments(tm, segs, delta_fold=0)
+            freqs_mid, _ = spin_frequency_host(tm, mids)
+            n_max = max(t.size for t in segs)
+            sec = np.zeros((len(segs), n_max))
+            msk = np.zeros(sec.shape, dtype=bool)
+            for r, t_seg in enumerate(segs):
+                sec[r, : t_seg.size] = (
+                    (t_seg - (t_seg[0] + t_seg[-1]) / 2) * 86400.0)
+                msk[r, : t_seg.size] = True
+            phs.append(pl)
+            hs.append(np.asarray(
+                search.h_power_segments(sec, msk, freqs_mid, nharm=5)))
+        return phs, hs
+
+    def timed(fn, *args):
+        best = np.inf
+        for _ in range(2):
+            t0 = time.perf_counter()
+            fn(*args)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    out: dict = {"n_int": n_int, "events_per_int": events_per_int,
+                 "speedup_gate": MULTISOURCE_SPEEDUP_GATE, "ab": []}
+    promoted = False
+    sources_per_s = None
+    for b in batch_sizes:
+        tms = [s[0] for s in sources[:b]]
+        seg_lists = [s[1] for s in sources[:b]]
+        bp, bh = batched(tms, seg_lists)  # compile both paths
+        lp, lh = looped(tms, seg_lists)
+        parity = all(
+            all(np.array_equal(x, y) for x, y in zip(pb, pl))
+            for pb, pl in zip(bp, lp)
+        ) and all(np.array_equal(hb, hl) for hb, hl in zip(bh, lh))
+        wall_b = timed(batched, tms, seg_lists)
+        wall_l = timed(looped, tms, seg_lists)
+        row = {"batch": b,
+               "sources_per_s_batched": round(b / wall_b, 1),
+               "sources_per_s_looped": round(b / wall_l, 1),
+               "speedup": round(wall_l / wall_b, 2),
+               "parity_bitwise": parity}
+        out["ab"].append(row)
+        log(f"[bench] multisource batch {b}: batched "
+            f"{row['sources_per_s_batched']:.1f} vs looped "
+            f"{row['sources_per_s_looped']:.1f} sources/s "
+            f"({row['speedup']:.2f}x, parity={parity})")
+        if b >= 64:
+            sources_per_s = max(sources_per_s or 0.0,
+                                row["sources_per_s_batched"])
+            if row["speedup"] > MULTISOURCE_SPEEDUP_GATE and parity:
+                promoted = True
+    out["promoted"] = promoted
+    out["sources_per_s"] = sources_per_s
+    out["persisted"] = False
+    if persist:
+        try:
+            for row in out["ab"]:
+                if row["batch"] < 64:
+                    continue
+                autotune.store_multisource(row["batch"], events_per_int, {
+                    "multisource": int(row["speedup"] >
+                                       MULTISOURCE_SPEEDUP_GATE
+                                       and row["parity_bitwise"]),
+                    "max_pad": autotune.MULTISOURCE_MAX_PAD_DEFAULT,
+                    "batch_cap": 0,
+                    "sources_per_s_batched": row["sources_per_s_batched"],
+                    "sources_per_s_looped": row["sources_per_s_looped"],
+                })
+            out["persisted"] = True
+        except Exception as exc:  # noqa: BLE001 - persistence is best-effort
+            log(f"[bench] multisource verdict not persisted: {exc}")
+    log(f"[bench] multisource gate: promoted={promoted} "
+        f"(>{MULTISOURCE_SPEEDUP_GATE}x at batch >=64 + bitwise parity)")
+    return out
+
+
 def bench_north_star(par_path: str, template_path: str, times: np.ndarray, intervals,
                      n_freq: int = 2500, n_fdot: int = 40, poly_trig: bool = False) -> dict:
     """The BASELINE north star as ONE wall clock: full 2-D (nu, nudot) Z^2
@@ -938,7 +1063,7 @@ def main():
 
     errors: dict[str, str] = {}
     # the step() call sites below, in order — heartbeat denominators
-    n_stages = 8  # surrogate warmup z2 grid_mxu delta_fold toas north_star config4
+    n_stages = 9  # surrogate warmup z2 grid_mxu delta_fold multisource toas north_star config4
     stages_done = [0]
 
     def step(name: str, fn, *args, **kwargs):
@@ -999,6 +1124,9 @@ def main():
                     n_trials=z2_trials, n_fdot=4 if on_cpu else 8)
 
     delta_fold = step("delta_fold", bench_delta_fold, par, times, intervals)
+
+    ms = step("multisource", bench_multisource,
+              events_per_int=scaled(100 if on_cpu else 300, 40))
 
     toas = step("toas", bench_toas, par, intervals_path, template, times, intervals)
     if toas:
@@ -1080,6 +1208,13 @@ def main():
         # gate (>2x + deviation under 1% of the per-ToA error bar + off
         # path bit-stable); the gated winner persists in the autotune cache
         "delta_fold_ab": delta_fold,
+        # survey batch engine A/B (ops/multisource.py): vmapped batched
+        # fold+H vs the per-source loop at several batch sizes, bitwise
+        # parity asserted; the gated verdict persists in the autotune
+        # cache. sources_per_s (batched rate at batch >= 64) joins the
+        # ledger's green-baseline gating (obs/ledger.py METRICS).
+        "multisource_ab": ms,
+        "sources_per_s": ms["sources_per_s"] if ms else None,
         # ToA-engine A/B: dense vs loop error scan (bit-identical bounds
         # asserted), bf16 vs f32 profile sweep (deviation-gated headline use)
         "toa_engine_ab": toas["engine_ab"] if toas else None,

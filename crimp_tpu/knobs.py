@@ -127,6 +127,16 @@ REGISTRY: dict[str, Knob] = _build_registry((
          doc="minimum compile seconds before a kernel persists to the cache"),
     Knob("CRIMP_TPU_TRACE_DIR", "unset", "path", consumer="utils/profiling.py",
          doc="jax.profiler trace directory for the hot pipeline stages"),
+    Knob("CRIMP_TPU_MULTISOURCE", "unset (batched engine on)", "int",
+         consumer="pipelines/survey.py via ops/autotune.py",
+         doc="survey multi-source batch engine on/off (0 forces the "
+             "per-source loop; per-source bits are padding-exact either way)"),
+    Knob("CRIMP_TPU_MULTISOURCE_MAX_PAD", "4.0", "float",
+         consumer="ops/multisource.py via ops/autotune.py",
+         doc="bucket-merge padding-waste cap for survey source buckets"),
+    Knob("CRIMP_TPU_MULTISOURCE_BATCH", "unset (resolved source block)", "int",
+         consumer="ops/multisource.py via ops/autotune.py",
+         doc="hard cap on sources per batched survey dispatch (0 = no cap)"),
     # -- observability (host-side telemetry; numeric-neutral by contract) ---
     Knob("CRIMP_TPU_OBS", "unset (off)", "bool", consumer="crimp_tpu/obs",
          doc="flight-recorder telemetry: spans/counters + an atomic run manifest"),

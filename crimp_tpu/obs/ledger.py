@@ -60,6 +60,7 @@ METRICS: dict[str, dict] = {
                                "better": "higher"},
     "config4_toas_per_sec": {"field": "config4_toas_per_sec",
                              "better": "higher"},
+    "sources_per_s": {"field": "sources_per_s", "better": "higher"},
     "warmup_s": {"field": "warmup_s", "better": "lower"},
     "backend_compile_s": {"field": ("compile_cache", "backend_compile_s"),
                           "better": "lower"},

@@ -202,6 +202,52 @@ def prepare_anchors(timMod, t_ref_mjd) -> AnchoredModel:
     )
 
 
+def pad_anchored(am: AnchoredModel, n_anchor: int, n_glitch: int, n_wave: int) -> AnchoredModel:
+    """Pad an AnchoredModel to target (A, G, W) shapes with INERT rows.
+
+    The padding conventions are the same ones prepare_anchors already uses
+    for absent terms, so padded entries contribute exactly +0.0 on device:
+    extra glitch columns get glep_off=-inf (never active) with gltd_sec=1
+    (no 0-division in the recovery term), extra wave harmonics get zero
+    amplitudes, and extra anchors get zero const/taylor rows (they are
+    only ever gathered by padded events, whose results are discarded).
+    This is what lets ops/multisource stack models of ragged glitch/wave
+    counts into one vmappable block without perturbing any real source's
+    bits. Shrinking is not supported (raises).
+    """
+    A, G = am.glep_off.shape
+    W = am.wave_a.shape[0]
+    if n_anchor < A or n_glitch < G or n_wave < W:
+        raise ValueError(
+            f"pad_anchored cannot shrink ({A},{G},{W}) -> "
+            f"({n_anchor},{n_glitch},{n_wave})"
+        )
+
+    def pad1(x, n, fill=0.0):
+        return np.concatenate([x, np.full(n - x.shape[0], fill, dtype=x.dtype)])
+
+    glep_off = np.full((n_anchor, n_glitch), -np.inf)
+    glep_off[:A, :G] = am.glep_off
+    taylor = np.zeros((n_anchor, am.taylor.shape[1]))
+    taylor[:A] = am.taylor
+    return AnchoredModel(
+        const=pad1(am.const, n_anchor),
+        taylor=taylor,
+        glep_off=glep_off,
+        glph=pad1(am.glph, n_glitch),
+        glf0=pad1(am.glf0, n_glitch),
+        glf1=pad1(am.glf1, n_glitch),
+        glf2=pad1(am.glf2, n_glitch),
+        glf0d=pad1(am.glf0d, n_glitch),
+        gltd_sec=pad1(am.gltd_sec, n_glitch, fill=1.0),
+        wep_off=pad1(am.wep_off, n_anchor),
+        wave_om_sec=am.wave_om_sec,
+        wave_a=pad1(am.wave_a, n_wave),
+        wave_b=pad1(am.wave_b, n_wave),
+        f0=am.f0,
+    )
+
+
 def anchor_deltas(times_mjd: np.ndarray, t_ref_mjd: np.ndarray, anchor_idx: np.ndarray) -> np.ndarray:
     """Event times as exact seconds relative to their anchor (host f64)."""
     return (
@@ -283,7 +329,8 @@ def anchored_fold(am: AnchoredModel, delta: jax.Array, anchor_idx: jax.Array) ->
 # ---------------------------------------------------------------------------
 
 
-def fold_segments(timMod, seg_times, t_ref_mjd=None, delta_fold=None):
+def fold_segments(timMod, seg_times, t_ref_mjd=None, delta_fold=None,
+                  cache_tag: str | None = None):
     """Anchored fold of ragged per-segment event times in ONE device call.
 
     The ToA-pipeline fold dance — one anchor per segment, events
@@ -300,6 +347,11 @@ def fold_segments(timMod, seg_times, t_ref_mjd=None, delta_fold=None):
     autotune.resolve_delta_fold (CRIMP_TPU_DELTA_FOLD env > cached bench
     A/B winner > off). With the knob off this function never touches the
     engine and stays bit-identical to the pre-engine path.
+
+    ``cache_tag`` namespaces the fold-cache key (on top of the model sha
+    the key already carries) — the survey pipeline passes the source name
+    so two sources can never contend for one cache slot even when their
+    event byte-streams coincide.
     """
     seg_times = [np.atleast_1d(np.asarray(t, dtype=np.float64)) for t in seg_times]
     if t_ref_mjd is None:
@@ -330,7 +382,7 @@ def fold_segments(timMod, seg_times, t_ref_mjd=None, delta_fold=None):
     if cfg["delta_fold"]:
         folded, _ = deltafold.cached_fold(
             tm, times_cat, sizes, t_ref, delta, anchor_idx, exact,
-            budget=cfg["budget"],
+            budget=cfg["budget"], tag=cache_tag,
         )
     else:
         folded = exact()

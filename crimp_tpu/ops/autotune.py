@@ -24,8 +24,10 @@ Cache key schema (one JSON file, atomic tmp+rename writes)::
 ``kernel`` is the variant family: "grid" (uniform-grid fast path, also
 used by the 2-D grid kernel — same inner tile structure), "grid_mxu"
 (the factorized matmul variant — its block optimum is MXU-shaped, not
-VPU-shaped, so it gets its own entries) or "general" (arbitrary-frequency
-blockwise kernel). Problem sizes are bucketed to their ceil-log2 so a
+VPU-shaped, so it gets its own entries), "general" (arbitrary-frequency
+blockwise kernel) or "multisource" (the survey batch engine — there the
+pair means (padded per-source event width, source rows per dispatch)).
+Problem sizes are bucketed to their ceil-log2 so a
 7.9e5-event scan and an 8.1e5-event scan share a tuning, while 1e5 and
 1e8 do not.
 
@@ -168,6 +170,13 @@ def static_defaults(kernel: str) -> tuple[int, int]:
 
     if kernel == "general":
         return search.DEFAULT_EVENT_BLOCK, search.DEFAULT_TRIAL_BLOCK
+    if kernel == "multisource":
+        # (event_block, source_block): padded per-source event width and
+        # source rows per dispatch for the survey batch engine
+        from crimp_tpu.ops import multisource
+
+        return (multisource.MULTISOURCE_EVENT_BLOCK,
+                multisource.MULTISOURCE_SOURCE_BLOCK)
     return search.GRID_EVENT_BLOCK, search.GRID_TRIAL_BLOCK
 
 
@@ -175,7 +184,7 @@ def env_blocks_override(kernel: str) -> tuple[int, int] | None:
     """Live CRIMP_TPU_GRID_BLOCKS value (grid kernels only; keeps today's
     meaning — the knob has always targeted the uniform-grid fast path).
     Re-read per call so it beats the cache even when set after import."""
-    if kernel == "general":
+    if kernel in ("general", "multisource"):
         return None
     from crimp_tpu.ops import search
 
@@ -200,7 +209,7 @@ def resolve_blocks(kernel: str, n_events: int, n_trials: int,
     miss (only when CRIMP_TPU_AUTOTUNE=1) > static module defaults.
     Never runs timing unless eager mode is opted into.
     """
-    if kernel not in ("grid", "grid_mxu", "general"):
+    if kernel not in ("grid", "grid_mxu", "general", "multisource"):
         raise ValueError(f"unknown kernel variant {kernel!r}")
     if event_block is not None and trial_block is not None:
         return int(event_block), int(trial_block)
@@ -473,6 +482,91 @@ def resolve_delta_fold(n_events: int) -> dict:
         out["delta_fold"] = env_d
     if env_b is not None:
         out["budget"] = env_b
+    return out
+
+
+# -- multisource survey engine knob -----------------------------------------
+#
+# CRIMP_TPU_MULTISOURCE switches pipelines/survey.py between the vmapped
+# multi-source batch engine and the per-source loop. Unlike grid_mxu /
+# delta_fold the batched path is the DEFAULT (per-source bits are
+# padding-exact by construction — docs/performance.md "Survey mode"), so
+# the cached entry mostly records the measured sources_per_s and lets a
+# failed promotion gate pin the loop (0) on hardware where batching loses.
+# CRIMP_TPU_MULTISOURCE_MAX_PAD caps the bucket-merge padding waste and
+# CRIMP_TPU_MULTISOURCE_BATCH hard-caps sources per bucket dispatch. The
+# cache key uses the kernel name "multisource_enable" so the on/off entry
+# can never collide with the "multisource" BLOCK-size entries
+# resolve_blocks() maintains.
+
+MULTISOURCE_ENV = "CRIMP_TPU_MULTISOURCE"
+MULTISOURCE_MAX_PAD_ENV = "CRIMP_TPU_MULTISOURCE_MAX_PAD"
+MULTISOURCE_BATCH_ENV = "CRIMP_TPU_MULTISOURCE_BATCH"
+MULTISOURCE_MAX_PAD_DEFAULT = 4.0
+
+
+def multisource_defaults() -> dict:
+    return {"multisource": 1, "max_pad": MULTISOURCE_MAX_PAD_DEFAULT,
+            "batch_cap": 0}
+
+
+def multisource_cache_key(n_sources: int, n_events: int,
+                          platform: str | None = None,
+                          device_kind: str | None = None) -> str:
+    return cache_key("multisource_enable", False, n_events, n_sources,
+                     platform=platform, device_kind=device_kind)
+
+
+def cached_multisource(n_sources: int, n_events: int) -> dict | None:
+    entry = _load_cache().get(multisource_cache_key(n_sources, n_events))
+    if not isinstance(entry, dict):
+        return None
+    m = entry.get("multisource")
+    if m not in (0, 1):
+        return None
+    out = {"multisource": m}
+    p = entry.get("max_pad")
+    if isinstance(p, (int, float)) and 0.0 < p < float("inf"):
+        out["max_pad"] = float(p)
+    return out
+
+
+def store_multisource(n_sources: int, n_events: int, entry: dict,
+                      path: pathlib.Path | None = None) -> None:
+    """Persist a gated multisource A/B verdict (bench.py calls this)."""
+    _store_entry(multisource_cache_key(n_sources, n_events), entry, path)
+
+
+def resolve_multisource(n_sources: int, n_events: int) -> dict:
+    """Resolve {multisource, max_pad, batch_cap} for a survey workload.
+
+    Precedence per knob: CRIMP_TPU_MULTISOURCE / _MAX_PAD / _BATCH (hard
+    overrides, honored even with autotune off; malformed raises) > cached
+    bench A/B verdict (unless CRIMP_TPU_AUTOTUNE=0) > defaults (batched
+    path ON, max_pad 4.0, no batch cap). Never times anything — the A/B
+    with its parity gate lives in bench.py (bench_multisource).
+    """
+    out = multisource_defaults()
+    env_m = _env_nonneg_int(MULTISOURCE_ENV, valid=(0, 1))
+    env_p = _env_pos_float(MULTISOURCE_MAX_PAD_ENV)
+    env_b = _env_nonneg_int(MULTISOURCE_BATCH_ENV)
+    if autotune_mode() != "off":
+        try:
+            cached = cached_multisource(n_sources, n_events)
+        except Exception:  # noqa: BLE001 — a corrupt cache or an
+            # uninitializable backend must never take down a survey call
+            logger.warning("multisource autotune cache lookup failed; using "
+                           "static defaults", exc_info=True)
+            cached = None
+        _count_cache(bool(cached))
+        if cached:
+            out.update(cached)
+    if env_m is not None:
+        out["multisource"] = env_m
+    if env_p is not None:
+        out["max_pad"] = env_p
+    if env_b is not None:
+        out["batch_cap"] = env_b
     return out
 
 

@@ -356,10 +356,21 @@ def fold_cache_mode() -> tuple[str, pathlib.Path | None]:
     return "disk", pathlib.Path(env)
 
 
-def fold_key(times_cat: np.ndarray, sizes, t_ref: np.ndarray) -> str:
+def fold_key(times_cat: np.ndarray, sizes, t_ref: np.ndarray,
+             model_sha: str | None = None, tag: str | None = None) -> str:
     """Cache key: event-set sha + segment layout + anchor sha + device
     fingerprint (fold bits are backend-dependent, so products never cross
-    backends)."""
+    backends).
+
+    ``model_sha`` folds the model's NONLINEAR fingerprint into the key:
+    two sources with identical event byte-streams but different timing
+    models (the multisource survey can legitimately produce this — e.g.
+    simulated sources sharing one event list) must occupy DISTINCT cache
+    slots instead of evicting each other on every alternation. Linear-only
+    parameter moves keep the same nonlinear sha, so the delta-refold path
+    is unaffected. ``tag`` is an optional caller namespace (the survey
+    passes the source name) for isolation even between identical models.
+    """
     from crimp_tpu.ops import autotune
 
     platform, device_kind = autotune.device_fingerprint()
@@ -370,6 +381,10 @@ def fold_key(times_cat: np.ndarray, sizes, t_ref: np.ndarray) -> str:
     h.update(np.ascontiguousarray(
         np.asarray(t_ref, dtype=np.float64)).tobytes())
     h.update(f"|{platform}|{device_kind}|v{CACHE_VERSION}".encode())
+    if model_sha is not None:
+        h.update(f"|model:{model_sha}".encode())
+    if tag is not None:
+        h.update(f"|tag:{tag}".encode())
     return h.hexdigest()
 
 
@@ -425,7 +440,7 @@ def _ensure_basis(prod: FoldProduct, tm, delta, anchor_idx) -> FoldBasis:
 
 
 def cached_fold(tm, times_cat, sizes, t_ref, delta, anchor_idx, exact_fn,
-                budget: float) -> tuple[np.ndarray, dict]:
+                budget: float, tag: str | None = None) -> tuple[np.ndarray, dict]:
     """The engine's entry point (anchored.fold_segments calls it when the
     knob resolves on): returns (folded phases (N,), info).
 
@@ -444,7 +459,7 @@ def cached_fold(tm, times_cat, sizes, t_ref, delta, anchor_idx, exact_fn,
     key = None
     prod = None
     if mode != "off":
-        key = fold_key(times_cat, sizes, t_ref)
+        key = fold_key(times_cat, sizes, t_ref, model_sha=nonlin, tag=tag)
         info["key"] = key[:16]
         prod = _mem_get(key)
         if prod is None and mode == "disk":

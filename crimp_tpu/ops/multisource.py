@@ -1,0 +1,438 @@
+"""Survey-scale multi-source batch engine: stacked fold / fit / H-test.
+
+CRIMP processes one pulsar per process end to end; every prior engine
+(dense ToA scans, MXU grid kernels, delta-fold refolds) inherits that
+single-source shape. This module lifts the per-source device paths to a
+LEADING SOURCE AXIS so hundreds of sources fold, search and ToA-fit in a
+handful of device invocations (the "PulsarX mode" of ROADMAP item 1):
+
+- :class:`StackedAnchoredModel` stacks per-source ``AnchoredModel`` blocks
+  struct-of-arrays style, padding ragged anchor/glitch/wave counts to the
+  batch max with INERT rows (``anchored.pad_anchored``) so the unmodified
+  ``anchored_fold`` vmaps cleanly and every real source's bits are
+  untouched;
+- whole sources are bucketed by padded event-count shape
+  (``toafit.bucket_by_pow2`` — the same policy ``fit_toas_bucketed``
+  applies to segments within a source), so one compiled executable per
+  bucket serves every source in it;
+- the fold, the per-segment H-test reduction and ``fit_segment`` are
+  vmapped across the source axis with per-source masks, chunked through
+  ``autotune.resolve_blocks("multisource", ...)`` so a single dispatch
+  never exceeds the tuned (event_block x source_block) cell budget.
+
+Bitwise contract: the fold is per-event ELEMENTWISE (no event-axis
+reduction), so batched fold bits equal the single-source fold bits for
+every source regardless of padding. The fit and the H-test reduce over
+the padded event axis, so their bits match the single-source path exactly
+when the padding is exact (every source in a bucket padded to the same
+width the single-source path would use); ragged buckets match to
+documented tolerance instead (docs/performance.md "Survey mode").
+
+On a multi-device host the stacked fold shards its source axis
+(parallel/mesh.py SOURCE_AXIS) — pure data parallelism, no collectives,
+bit-identical to the unsharded dispatch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from crimp_tpu import obs
+from crimp_tpu.models import timing
+from crimp_tpu.ops import anchored, search, toafit
+from crimp_tpu.ops.anchored import AnchoredModel
+from crimp_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+# Static defaults for the "multisource" autotune key: event_block is the
+# padded per-source event width, source_block the source rows per
+# dispatch; together they bound a dispatch to ~event_block*source_block
+# padded cells (the memory governor _source_chunk enforces).
+MULTISOURCE_EVENT_BLOCK = 1 << 15
+MULTISOURCE_SOURCE_BLOCK = 256
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class StackedAnchoredModel:
+    """``AnchoredModel`` with a leading source axis on every leaf (B, ...).
+
+    Field names and meanings mirror :class:`~crimp_tpu.ops.anchored.
+    AnchoredModel` exactly; ``vmap`` over this pytree therefore hands the
+    unmodified single-source fold one ordinary ``AnchoredModel`` row at a
+    time. Build with :func:`stack_models`.
+    """
+
+    const: jax.Array  # (B, A)
+    taylor: jax.Array  # (B, A, 13)
+    glep_off: jax.Array  # (B, A, G)
+    glph: jax.Array  # (B, G)
+    glf0: jax.Array  # (B, G)
+    glf1: jax.Array  # (B, G)
+    glf2: jax.Array  # (B, G)
+    glf0d: jax.Array  # (B, G)
+    gltd_sec: jax.Array  # (B, G)
+    wep_off: jax.Array  # (B, A)
+    wave_om_sec: jax.Array  # (B,)
+    wave_a: jax.Array  # (B, W)
+    wave_b: jax.Array  # (B, W)
+    f0: jax.Array  # (B,)
+
+    @property
+    def n_source(self) -> int:
+        return int(self.const.shape[0])
+
+
+_FIELDS = tuple(f.name for f in dataclasses.fields(StackedAnchoredModel))
+
+
+def stack_models(models: list[AnchoredModel]) -> StackedAnchoredModel:
+    """Stack per-source AnchoredModels into one struct-of-arrays block.
+
+    Ragged anchor/glitch/wave counts are padded to the batch max with the
+    inert rows of ``anchored.pad_anchored`` (zero-amplitude waves,
+    never-active glitches), which contribute exactly +0.0 on device — the
+    stacked fold of each row stays bitwise identical to that source's
+    single-model fold.
+    """
+    if not models:
+        raise ValueError("stack_models needs at least one model")
+    n_anchor = max(m.const.shape[0] for m in models)
+    n_glitch = max(m.glph.shape[0] for m in models)
+    n_wave = max(m.wave_a.shape[0] for m in models)
+    padded = [anchored.pad_anchored(m, n_anchor, n_glitch, n_wave) for m in models]
+    return StackedAnchoredModel(
+        **{name: np.stack([np.asarray(getattr(m, name)) for m in padded])
+           for name in _FIELDS}
+    )
+
+
+def inert_rows(like: StackedAnchoredModel, n: int) -> StackedAnchoredModel:
+    """``n`` padding source rows shaped like ``like`` that fold to frac(0).
+
+    Used to pad a stacked batch to a device multiple before source-axis
+    sharding: zero const/taylor, never-active glitches (glep_off=-inf,
+    gltd_sec=1), zero-amplitude waves.
+    """
+    A = like.const.shape[1]
+    G = like.glph.shape[1]
+    W = like.wave_a.shape[1]
+    row = anchored.pad_anchored(
+        AnchoredModel(
+            const=np.zeros(A), taylor=np.zeros((A, like.taylor.shape[2])),
+            glep_off=np.zeros((A, 0)), glph=np.zeros(0), glf0=np.zeros(0),
+            glf1=np.zeros(0), glf2=np.zeros(0), glf0d=np.zeros(0),
+            gltd_sec=np.zeros(0), wep_off=np.zeros(A),
+            wave_om_sec=np.asarray(0.0), wave_a=np.zeros(0),
+            wave_b=np.zeros(0), f0=np.asarray(1.0),
+        ),
+        A, G, W,
+    )
+    return StackedAnchoredModel(
+        **{name: np.broadcast_to(
+            np.asarray(getattr(row, name))[None],
+            (n,) + np.shape(getattr(row, name))).copy()
+           for name in _FIELDS}
+    )
+
+
+def concat_stacked(a: StackedAnchoredModel, b: StackedAnchoredModel) -> StackedAnchoredModel:
+    return StackedAnchoredModel(
+        **{name: np.concatenate([np.asarray(getattr(a, name)),
+                                 np.asarray(getattr(b, name))])
+           for name in _FIELDS}
+    )
+
+
+def _row_fold(sm: StackedAnchoredModel, delta: jax.Array, anchor_idx: jax.Array) -> jax.Array:
+    # under vmap every leaf loses its source axis, so this IS an
+    # AnchoredModel row — hand it to the unmodified single-source kernel
+    am = AnchoredModel(**{name: getattr(sm, name) for name in _FIELDS})
+    return anchored.anchored_fold(am, delta, anchor_idx)
+
+
+@jax.jit
+def stacked_fold(sm: StackedAnchoredModel, delta: jax.Array, anchor_idx: jax.Array) -> jax.Array:
+    """Cycle-folded phases (B, E) for B sources in ONE device invocation.
+
+    ``delta`` (B, E) are per-source anchored second offsets padded to the
+    bucket width E; ``anchor_idx`` (B, E) their per-event anchor rows
+    (padding slots may carry any valid index — their outputs are
+    discarded). Per-row bits are identical to ``anchored_fold`` on that
+    source alone: the fold is elementwise over events, and vmap batches
+    the arithmetic without reassociating it.
+    """
+    return jax.vmap(_row_fold)(sm, delta, anchor_idx)
+
+
+# ---------------------------------------------------------------------------
+# Source bucketing + dispatch chunking
+# ---------------------------------------------------------------------------
+
+
+def bucket_sources(sizes, max_pad_ratio: float = 4.0,
+                   batch_cap: int = 0) -> list[list[int]]:
+    """Bucket whole sources by padded size (pow2 merge, then a batch cap).
+
+    ``sizes`` is the per-source padding-relevant size (the survey uses the
+    max per-segment event count — the width the fit/H-test pad to).
+    Generalizes ``toafit.bucket_by_pow2`` from segments-within-a-source to
+    sources-within-a-survey; ``batch_cap`` > 0 additionally splits each
+    bucket so no single dispatch exceeds that many sources.
+    """
+    buckets = toafit.bucket_by_pow2(sizes, max_pad_ratio)
+    if batch_cap and batch_cap > 0:
+        split: list[list[int]] = []
+        for b in buckets:
+            split.extend(b[i:i + batch_cap] for i in range(0, len(b), batch_cap))
+        buckets = split
+    obs.counter_add("bucket_count", len(buckets))
+    return buckets
+
+
+def _source_chunk(source_block: int, event_block: int, width: int) -> int:
+    """Sources per dispatch so a chunk stays under the tuned cell budget
+    (~event_block * source_block padded cells), but never below 1."""
+    cells = max(1, int(event_block)) * max(1, int(source_block))
+    return max(1, min(int(source_block), cells // max(int(width), 1)))
+
+
+def _resolve_chunk(n_sources: int, width: int) -> int:
+    from crimp_tpu.ops import autotune
+
+    eb, sb = autotune.resolve_blocks("multisource", max(width, 1),
+                                     max(n_sources, 1))
+    return _source_chunk(sb, eb, width)
+
+
+# ---------------------------------------------------------------------------
+# Batched fold across sources
+# ---------------------------------------------------------------------------
+
+
+def fold_sources(timing_models, seg_times_list, t_ref_list=None):
+    """Anchored fold of MANY sources' ragged segments, batched on device.
+
+    ``timing_models`` is one timing model per source (anything
+    ``timing.resolve`` accepts); ``seg_times_list`` one list of per-segment
+    MJD arrays per source. Per source, anchors default to each segment's
+    midpoint (exactly ``anchored.fold_segments``); host prep — longdouble
+    anchor phases, re-centered Taylor coefficients — runs per source, then
+    the stacked f64 kernel folds every source in source-chunked vmapped
+    dispatches. Returns ``(phase_lists, t_refs)``: per source, the list of
+    cycle-folded [0,1) segment phase arrays plus the anchors used.
+
+    Bitwise identical per source to ``fold_segments`` with the delta-fold
+    engine off (the batched path never routes through the fold cache —
+    its products are keyed per single-source call).
+    """
+    B = len(seg_times_list)
+    if B == 0:
+        return [], []
+    prepped = []
+    for src_i, (tm, seg_times) in enumerate(zip(timing_models, seg_times_list)):
+        tm = timing.resolve(tm)
+        seg_times = [np.atleast_1d(np.asarray(t, dtype=np.float64))
+                     for t in seg_times]
+        if t_ref_list is not None and t_ref_list[src_i] is not None:
+            t_ref = np.atleast_1d(np.asarray(t_ref_list[src_i], dtype=np.float64))
+        else:
+            t_ref = np.asarray(
+                [(t[-1] - t[0]) / 2 + t[0] if t.size else 0.0 for t in seg_times]
+            )
+        if t_ref.size == 0:
+            # a source with no segments still needs one (dummy) anchor so
+            # the stacked gather never indexes an empty table
+            t_ref = np.zeros(1)
+        sizes = [t.size for t in seg_times]
+        anchor_idx = (np.repeat(np.arange(len(seg_times)), sizes)
+                      if seg_times else np.zeros(0, dtype=np.int64))
+        times_cat = np.concatenate(seg_times) if seg_times else np.zeros(0)
+        delta = anchored.anchor_deltas(times_cat, t_ref, anchor_idx) \
+            if times_cat.size else np.zeros(0)
+        am = anchored.prepare_anchors(tm, t_ref)
+        prepped.append((am, delta, anchor_idx, sizes, t_ref))
+        obs.counter_add("events_folded", int(times_cat.size))
+        obs.counter_add("fold_segments", len(seg_times))
+    obs.counter_add("sources_batched", B)
+
+    E_max = max(max((p[1].size for p in prepped), default=1), 1)
+    chunk = _resolve_chunk(B, E_max)
+    folded_rows: list[np.ndarray] = []
+    for lo in range(0, B, chunk):
+        part = prepped[lo:lo + chunk]
+        sm = stack_models([p[0] for p in part])
+        delta_pad = np.zeros((len(part), E_max))
+        idx_pad = np.zeros((len(part), E_max), dtype=np.int64)
+        for r, (_, delta, anchor_idx, _, _) in enumerate(part):
+            delta_pad[r, : delta.size] = delta
+            idx_pad[r, : anchor_idx.size] = anchor_idx
+        sm, delta_dev, idx_dev, n_real = _maybe_shard_sources(
+            sm, delta_pad, idx_pad
+        )
+        rows = np.asarray(stacked_fold(sm, delta_dev, idx_dev))[:n_real]
+        folded_rows.extend(rows)
+    phase_lists = []
+    t_refs = []
+    for (_, delta, _, sizes, t_ref), row in zip(prepped, folded_rows):
+        flat = row[: delta.size]
+        phase_lists.append(list(np.split(flat, np.cumsum(sizes)[:-1]))
+                           if sizes else [])
+        t_refs.append(t_ref)
+    return phase_lists, t_refs
+
+
+def _maybe_shard_sources(sm: StackedAnchoredModel, delta: np.ndarray,
+                         idx: np.ndarray):
+    """Shard the source axis across devices when it pays (pure data
+    parallelism; bitwise identical to the unsharded dispatch). Returns
+    possibly-padded (sm, delta, idx) plus the real row count."""
+    from crimp_tpu.parallel import mesh as pmesh
+
+    n = sm.n_source
+    if not pmesh.sharding_enabled():
+        return sm, jnp.asarray(delta), jnp.asarray(idx), n
+    n_devices = len(jax.devices())
+    if n_devices < 2 or n < n_devices:
+        return sm, jnp.asarray(delta), jnp.asarray(idx), n
+    smesh = pmesh.source_mesh()
+    pad = pmesh.pad_batch_for_mesh(n, smesh, axis_name=pmesh.SOURCE_AXIS)
+    if pad:
+        sm = concat_stacked(sm, inert_rows(sm, pad))
+        delta = np.concatenate([delta, np.zeros((pad,) + delta.shape[1:])])
+        idx = np.concatenate([idx, np.zeros((pad,) + idx.shape[1:], idx.dtype)])
+    sm = StackedAnchoredModel(
+        **{name: pmesh.shard_sources(np.asarray(getattr(sm, name)), smesh)
+           for name in _FIELDS}
+    )
+    return (sm, pmesh.shard_sources(delta, smesh),
+            pmesh.shard_sources(idx, smesh), n)
+
+
+# ---------------------------------------------------------------------------
+# Batched ToA fit across sources
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("kind", "cfg"))
+def fit_toas_batch_multi(kind, tpls, phases, masks, exposures, cfg):
+    """``toafit.fit_toas_batch`` with a PER-ROW template.
+
+    ``tpls`` is a ProfileParams pytree whose leaves carry a leading row
+    axis (one template per padded segment row) — the cross-source batch
+    where sources disagree on template parameters but share the profile
+    family, component count and fit config.
+    """
+    return jax.vmap(
+        lambda tpl, x, m, t: toafit.fit_segment(kind, tpl, x, m, t, cfg)
+    )(tpls, phases, masks, exposures)
+
+
+def _templates_identical(tpls) -> bool:
+    first = tpls[0]
+    leaves0 = jax.tree_util.tree_leaves(first)
+    for t in tpls[1:]:
+        leaves = jax.tree_util.tree_leaves(t)
+        if len(leaves) != len(leaves0) or any(
+            not np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(leaves0, leaves)
+        ):
+            return False
+    return True
+
+
+def fit_sources(kind, tpls, phase_lists, exposure_list, cfg):
+    """ToA-fit every segment of every source in batched dispatches.
+
+    ``tpls`` is one ProfileParams per source (same family ``kind`` and
+    component count — group sources before calling); ``phase_lists`` the
+    per-source lists of folded segment phases (radians already applied for
+    the CAUCHY/VONMISES families); ``exposure_list`` per-source exposure
+    arrays. All (source, segment) rows flatten into ONE segment batch
+    padded to the bucket-wide max width. When every source carries a
+    bitwise-identical template the batch routes through
+    ``toafit.fit_toas_batch_auto`` (shared template, segment-axis
+    auto-sharding — bits equal the single-source path when the padded
+    width matches); otherwise the per-row-template vmap runs. Returns the
+    flat result dict plus the per-source row slices.
+    """
+    rows: list[np.ndarray] = []
+    row_tpl_idx: list[int] = []
+    exposures: list[float] = []
+    slices: list[slice] = []
+    for src_i, (plist, exps) in enumerate(zip(phase_lists, exposure_list)):
+        start = len(rows)
+        rows.extend(plist)
+        row_tpl_idx.extend([src_i] * len(plist))
+        exposures.extend(np.asarray(exps, dtype=float).tolist())
+        slices.append(slice(start, len(rows)))
+    if not rows:
+        return {}, slices
+    phases, masks = toafit.pad_segments(rows)
+    exposures = np.asarray(exposures, dtype=float)
+    if _templates_identical(tpls):
+        out = toafit.fit_toas_batch_auto(kind, tpls[0], phases, masks,
+                                         exposures, cfg)
+    else:
+        obs.counter_add("toas_fit", len(rows))
+        cfg = toafit.resolve_runtime_cfg(cfg, len(rows), phases.shape[1])
+        tpl_rows = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(
+                [jnp.asarray(leaves[i]) for i in row_tpl_idx]
+            ),
+            *tpls,
+        )
+        out = fit_toas_batch_multi(kind, tpl_rows, jnp.asarray(phases),
+                                   jnp.asarray(masks), jnp.asarray(exposures),
+                                   cfg)
+    return {k: np.asarray(v) for k, v in out.items()}, slices
+
+
+# ---------------------------------------------------------------------------
+# Batched per-ToA H-test across sources
+# ---------------------------------------------------------------------------
+
+
+def h_power_sources(seg_times_list, freqs_list, nharm: int = 5):
+    """Per-ToA H-test for every (source, segment) row in chunked batches.
+
+    ``seg_times_list``: per source, the list of per-segment event MJD
+    arrays; ``freqs_list``: per source, the per-segment trial frequency
+    (the local ephemeris frequency at the ToA epoch). Rows are centered
+    to seconds exactly like the single-source pipeline and dispatched
+    through ``search.h_power_segments`` in source-block-sized chunks.
+    Returns one (S_i,) H-power array per source.
+    """
+    rows = []
+    freqs = []
+    slices = []
+    for seg_times, fs in zip(seg_times_list, freqs_list):
+        start = len(rows)
+        for t_seg in seg_times:
+            t_seg = np.asarray(t_seg, dtype=np.float64)
+            centered = ((t_seg - (t_seg[0] + t_seg[-1]) / 2) * 86400.0
+                        if t_seg.size else t_seg)
+            rows.append(centered)
+        freqs.extend(np.asarray(fs, dtype=float).tolist())
+        slices.append(slice(start, len(rows)))
+    if not rows:
+        return [np.zeros(0) for _ in seg_times_list]
+    width = max(max((r.size for r in rows), default=1), 1)
+    sec_padded = np.zeros((len(rows), width))
+    sec_masks = np.zeros((len(rows), width), dtype=bool)
+    for i, r in enumerate(rows):
+        sec_padded[i, : r.size] = r
+        sec_masks[i, : r.size] = True
+    chunk = _resolve_chunk(len(rows), width)
+    h = np.asarray(search.h_power_segments_chunked(
+        sec_padded, sec_masks, np.asarray(freqs), nharm=nharm,
+        row_block=chunk,
+    ))
+    return [h[s] for s in slices]

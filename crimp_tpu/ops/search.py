@@ -1247,6 +1247,41 @@ def h_power_segments(
     return jax.vmap(one)(times, masks, freqs)
 
 
+def h_power_segments_chunked(times, masks, freqs, nharm: int = 5,
+                             row_block: int | None = None,
+                             trig_dtype=DEFAULT_TRIG_DTYPE) -> np.ndarray:
+    """``h_power_segments`` dispatched in row chunks of ``row_block``.
+
+    The memory governor for survey-scale stacked batches (ops/multisource
+    flattens every (source, segment) row into one call): each chunk is its
+    own device dispatch, so the vmapped (rows, events, harmonics) temps
+    never exceed ~row_block padded rows. Per-row bits are identical to the
+    single-call path — vmap batches rows independently, so splitting the
+    batch cannot reassociate any row's reduction. ``row_block`` None/<=0
+    or >= the row count collapses to one call.
+    """
+    times = np.asarray(times)
+    n_rows = times.shape[0]
+    if row_block is None or row_block <= 0 or row_block >= n_rows:
+        return np.asarray(
+            h_power_segments(jnp.asarray(times), jnp.asarray(masks),
+                             jnp.asarray(freqs), nharm=nharm,
+                             trig_dtype=trig_dtype)
+        )
+    masks = np.asarray(masks)
+    freqs = np.asarray(freqs)
+    # pipelined like fit_toas_bucketed: dispatch every chunk first (JAX
+    # async dispatch), then materialize in order
+    pending = [
+        h_power_segments(jnp.asarray(times[lo:lo + row_block]),
+                         jnp.asarray(masks[lo:lo + row_block]),
+                         jnp.asarray(freqs[lo:lo + row_block]),
+                         nharm=nharm, trig_dtype=trig_dtype)
+        for lo in range(0, n_rows, row_block)
+    ]
+    return np.concatenate([np.asarray(p) for p in pending])
+
+
 class PeriodSearch:
     """Reference-compatible search API (periodsearch.py:20-125).
 

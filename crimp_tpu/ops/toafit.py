@@ -853,6 +853,38 @@ def pad_segments(phase_list: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray]:
     return phases, masks
 
 
+def bucket_by_pow2(sizes, max_pad_ratio: float = 4.0) -> list[list[int]]:
+    """Group indices of ``sizes`` into power-of-two size buckets.
+
+    The shared bucketing policy of the batched engines: sort by size
+    (stable), assign each item its ceil-pow2 capacity, and merge
+    consecutive capacities while the padding waste for the smallest member
+    stays under ``max_pad_ratio``. Used by ``fit_toas_bucketed``
+    (segments-within-a-source) and by ops/multisource (whole sources
+    within a survey). Returns buckets of ORIGINAL indices, smallest sizes
+    first; homogeneous inputs collapse to a single bucket.
+    """
+    sizes = np.asarray(sizes)
+    if sizes.size == 0:
+        return []
+    order = np.argsort(sizes, kind="stable")
+    # bucket boundaries: next power of two of each item's size
+    pow2 = 1 << np.ceil(np.log2(np.maximum(sizes[order], 1))).astype(int)
+    buckets: list[list[int]] = []
+    current: list[int] = []
+    current_cap = pow2[0]
+    for pos, idx in enumerate(order):
+        cap = pow2[pos]
+        if current and cap > current_cap and cap > max_pad_ratio * sizes[current[0]]:
+            buckets.append(current)
+            current = []
+        current.append(int(idx))
+        current_cap = cap
+    if current:
+        buckets.append(current)
+    return buckets
+
+
 def fit_toas_bucketed(
     kind: str,
     tpl: ProfileParams,
@@ -882,21 +914,7 @@ def fit_toas_bucketed(
     if len(phase_list) == 0:
         return {}
     cfg = resolve_runtime_cfg(cfg, len(phase_list), int(sizes.max()))
-    order = np.argsort(sizes, kind="stable")
-    # bucket boundaries: next power of two of each segment size
-    pow2 = 1 << np.ceil(np.log2(np.maximum(sizes[order], 1))).astype(int)
-    buckets: list[list[int]] = []
-    current: list[int] = []
-    current_cap = pow2[0]
-    for pos, seg_idx in enumerate(order):
-        cap = pow2[pos]
-        if current and cap > current_cap and cap > max_pad_ratio * sizes[current[0]]:
-            buckets.append(current)
-            current = []
-        current.append(int(seg_idx))
-        current_cap = cap
-    if current:
-        buckets.append(current)
+    buckets = bucket_by_pow2(sizes, max_pad_ratio)
 
     exposures = np.asarray(exposures, dtype=float)
     # Pass 1 — dispatch: pad + enqueue every bucket's fit without touching

@@ -71,6 +71,7 @@ from crimp_tpu.ops.search import (
 EVENT_AXIS = "events"
 TRIAL_AXIS = "trials"
 SEGMENT_AXIS = "segments"
+SOURCE_AXIS = "sources"
 
 
 def sharding_enabled() -> bool:
@@ -124,6 +125,27 @@ def segment_mesh(devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (SEGMENT_AXIS,))
+
+
+def source_mesh(devices=None) -> Mesh:
+    """A 1-D mesh over all (or given) devices for source-batched survey
+    dispatches (ops/multisource stacked folds)."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (SOURCE_AXIS,))
+
+
+def shard_sources(array, mesh: Mesh):
+    """Place a stacked (source-major) array with its leading axis sharded.
+
+    Pure data parallelism for the multisource engine: the stacked fold is
+    elementwise per source row, so sharding the leading axis introduces no
+    collectives and no reduction-order change — bitwise identical to the
+    single-device dispatch (the same contract shard_segments gives the
+    ToA-segment fits)."""
+    spec = [None] * np.ndim(array)
+    spec[0] = SOURCE_AXIS
+    return jax.device_put(np.asarray(array), NamedSharding(mesh, P(*spec)))
 
 
 def _pad_to(x: np.ndarray, multiple: int, fill=0.0):

@@ -1,0 +1,354 @@
+"""Survey-scale ToA measurement: many pulsars per device invocation.
+
+The per-source pipeline (pipelines/measure_toas.py) runs one pulsar per
+process end to end. This driver lifts it to fleet scale on the
+ops/multisource batch engine: per-source timing models stack into
+struct-of-arrays blocks, whole sources bucket by padded event-count shape
+(one compiled executable per bucket), and the anchored fold, the per-ToA
+H-test and the template fit all vmap across the source axis. A
+100-source survey runs as a handful of device programs instead of 100
+serial pipeline invocations.
+
+Failure domain: one pathological source (empty interval, malformed
+model/template, a bucket-level device failure) degrades to the
+single-source path — ``measure_source_toas`` — instead of poisoning its
+batch; sources whose fallback also fails get ``None`` with the error
+recorded in :func:`last_survey_info`.
+
+Parity contract (pinned by tests/test_survey.py): the batched path
+matches ``measure_source_toas`` looped over sources BIT-IDENTICALLY
+per source when padding is exact — every source in a bucket padded to
+the width its solo run would use (equal max segment event counts, and a
+segment-size ratio that keeps the solo path off its own bucketed branch).
+Ragged buckets change the padded reduction widths of the fit and H-test,
+so they match to documented tolerance instead (docs/performance.md
+"Survey mode"); the fold itself is elementwise and stays bitwise for
+every source regardless of padding.
+
+Knobs (ops/autotune.resolve_multisource): ``CRIMP_TPU_MULTISOURCE=0``
+forces the per-source loop; ``CRIMP_TPU_MULTISOURCE_MAX_PAD`` caps the
+bucket-merge padding waste; ``CRIMP_TPU_MULTISOURCE_BATCH`` caps sources
+per dispatch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import pandas as pd
+
+from crimp_tpu import obs
+from crimp_tpu.io import template as template_io
+from crimp_tpu.models import profiles, timing
+from crimp_tpu.ops import anchored, multisource, search, toafit
+from crimp_tpu.ops.ephem import spin_frequency_host
+from crimp_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+SURVEY_TOA_COLUMNS = [
+    "ToA", "ToA_mid", "ToA_start", "ToA_end", "ToA_lenInt", "ToA_exp",
+    "nbr_events", "count_rate", "phShift", "phShift_LL", "phShift_UL",
+    "Hpower", "redChi2",
+]
+
+_last_info: dict = {}
+
+
+def last_survey_info() -> dict:
+    """Telemetry for the most recent survey_measure_toas call: source
+    counts per path, per-source errors, bucket layout and padding
+    occupancy."""
+    return dict(_last_info)
+
+
+@dataclass
+class SourceSpec:
+    """One survey target, fully in memory.
+
+    ``times``: event MJDs (sorted); ``timing_model``: anything
+    ``timing.resolve`` accepts (TimingParams, parameter dict, .par path);
+    ``template``: a template dict (``template_io.read_template`` shape) or
+    a path to one; ``intervals``: the ToA interval table — a DataFrame
+    with ``ToA_tstart`` / ``ToA_tend`` / ``ToA_exposure`` columns
+    (``ToA_lenInt`` optional) or a path to a whitespace interval file.
+    """
+
+    name: str
+    times: np.ndarray
+    timing_model: object
+    template: object
+    intervals: object
+
+    def interval_frame(self) -> pd.DataFrame:
+        if isinstance(self.intervals, pd.DataFrame):
+            return self.intervals
+        return pd.read_csv(self.intervals, sep=r"\s+", comment="#")
+
+    def template_dict(self) -> dict:
+        if isinstance(self.template, dict):
+            return self.template
+        return template_io.read_template(self.template)
+
+
+@dataclass
+class _Prepped:
+    """Host-side per-source prep shared by the batched and solo paths."""
+
+    spec: SourceSpec
+    tm: object
+    kind: str
+    tpl: object
+    cfg: object
+    seg_times: list = field(default_factory=list)
+    starts: np.ndarray = None
+    ends: np.ndarray = None
+    exposures: np.ndarray = None
+    len_int: np.ndarray = None
+
+    @property
+    def max_seg(self) -> int:
+        return max((t.size for t in self.seg_times), default=0)
+
+
+def _build_cfg(kind: str, phShiftRes: int, nbrBins: int, varyAmps: bool):
+    # the non-readvaryparam branch of measure_toas, verbatim: ampShift box
+    # bounds per family (measureToAs.py:308,461,605)
+    amp_lo, amp_hi = {
+        profiles.FOURIER: (0.01, 100.0),
+        profiles.CAUCHY: (1e-6, 1e6),
+        profiles.VONMISES: (1e-6, 500.0),
+    }[kind]
+    return toafit.ToAFitConfig(
+        kind=kind, ph_shift_res=phShiftRes, nbins=nbrBins,
+        vary_amps=varyAmps, amp_lo=amp_lo, amp_hi=amp_hi,
+    )
+
+
+def _prep_source(spec: SourceSpec, phShiftRes: int, nbrBins: int,
+                 varyAmps: bool) -> _Prepped:
+    tm = timing.resolve(spec.timing_model)
+    kind, tpl = profiles.from_template(spec.template_dict())
+    intervals = spec.interval_frame()
+    starts = intervals["ToA_tstart"].to_numpy()
+    ends = intervals["ToA_tend"].to_numpy()
+    exposures = intervals["ToA_exposure"].to_numpy().astype(float)
+    len_int = (intervals["ToA_lenInt"].to_numpy()
+               if "ToA_lenInt" in intervals else ends - starts)
+    times = np.asarray(spec.times, dtype=np.float64)
+    seg_times = toafit.slice_sorted_intervals(times, starts, ends)
+    for ii, t_seg in enumerate(seg_times):
+        if t_seg.size == 0:
+            raise ValueError(
+                f"source {spec.name!r}: ToA interval {ii} contains no events"
+            )
+    return _Prepped(
+        spec=spec, tm=tm, kind=kind, tpl=tpl,
+        cfg=_build_cfg(kind, phShiftRes, nbrBins, varyAmps),
+        seg_times=seg_times, starts=starts, ends=ends, exposures=exposures,
+        len_int=np.asarray(len_int, dtype=float),
+    )
+
+
+def _assemble_frame(prep: _Prepped, toa_mids, results: dict,
+                    h_powers) -> pd.DataFrame:
+    n_seg = len(prep.seg_times)
+    nbr_events = np.asarray([t.size for t in prep.seg_times])
+    return pd.DataFrame({
+        "ToA": np.arange(n_seg),
+        "ToA_mid": np.asarray(toa_mids),
+        "ToA_start": prep.starts[:n_seg],
+        "ToA_end": prep.ends[:n_seg],
+        "ToA_lenInt": prep.len_int[:n_seg],
+        "ToA_exp": prep.exposures[:n_seg],
+        "nbr_events": nbr_events,
+        "count_rate": nbr_events / prep.exposures[:n_seg],
+        "phShift": np.asarray(results["phShift"]),
+        "phShift_LL": np.asarray(results["phShift_LL"]),
+        "phShift_UL": np.asarray(results["phShift_UL"]),
+        "Hpower": np.asarray(h_powers),
+        "redChi2": np.asarray(results["redChi2"]),
+    }, columns=SURVEY_TOA_COLUMNS)
+
+
+def _empty_frame() -> pd.DataFrame:
+    return pd.DataFrame({c: [] for c in SURVEY_TOA_COLUMNS},
+                        columns=SURVEY_TOA_COLUMNS)
+
+
+def _centered_seconds(seg_times: list[np.ndarray]) -> tuple[np.ndarray, np.ndarray]:
+    n_max = max((t.size for t in seg_times), default=1)
+    sec = np.zeros((len(seg_times), max(n_max, 1)))
+    msk = np.zeros(sec.shape, dtype=bool)
+    for i, t_seg in enumerate(seg_times):
+        if t_seg.size:
+            sec[i, : t_seg.size] = (t_seg - (t_seg[0] + t_seg[-1]) / 2) * 86400.0
+            msk[i, : t_seg.size] = True
+    return sec, msk
+
+
+def measure_source_toas(spec: SourceSpec, phShiftRes: int = 1000,
+                        nbrBins: int = 15, varyAmps: bool = False,
+                        _prep: _Prepped | None = None) -> pd.DataFrame:
+    """Single-source in-memory ToA measurement — the survey's per-source
+    fallback AND parity reference.
+
+    The computation mirrors ``measure_toas`` (anchored per-interval fold,
+    padded batch fit with the same size-ratio bucketing branch, per-ToA
+    H-test at the local ephemeris frequency) without any of its file
+    outputs; returns the per-source ToA DataFrame (SURVEY_TOA_COLUMNS).
+    """
+    prep = _prep if _prep is not None else _prep_source(
+        spec, phShiftRes, nbrBins, varyAmps
+    )
+    if not prep.seg_times:
+        return _empty_frame()
+    seg_phase_list, toa_mids = anchored.fold_segments(
+        prep.tm, prep.seg_times, cache_tag=spec.name
+    )
+    if prep.kind in (profiles.CAUCHY, profiles.VONMISES):
+        seg_phase_list = [p * (2 * np.pi) for p in seg_phase_list]
+    seg_sizes = [t.size for t in prep.seg_times]
+    size_ratio = max(seg_sizes) / max(min(seg_sizes), 1)
+    if size_ratio > 4.0:
+        results = toafit.fit_toas_bucketed(
+            prep.kind, prep.tpl, seg_phase_list, prep.exposures, prep.cfg
+        )
+    else:
+        phases, masks = toafit.pad_segments(seg_phase_list)
+        results = toafit.fit_toas_batch_auto(
+            prep.kind, prep.tpl, phases, masks, prep.exposures, prep.cfg
+        )
+        results = {k: np.asarray(v) for k, v in results.items()}
+    freqs_mid, _ = spin_frequency_host(prep.tm, toa_mids)
+    sec, msk = _centered_seconds(prep.seg_times)
+    h_powers = np.asarray(search.h_power_segments(sec, msk, freqs_mid, nharm=5))
+    return _assemble_frame(prep, toa_mids, results, h_powers)
+
+
+def survey_measure_toas(specs, phShiftRes: int = 1000, nbrBins: int = 15,
+                        varyAmps: bool = False) -> list[pd.DataFrame | None]:
+    """Measure ToAs for MANY sources in batched device invocations.
+
+    Returns one DataFrame per spec (order preserved); ``None`` for sources
+    whose fallback also failed (error in :func:`last_survey_info`).
+    Flight-recorded as an obs run with ``sources_batched`` /
+    ``bucket_count`` / ``bucket_occupancy_pct`` telemetry and an
+    ``obs.beat(label="sources")`` per-bucket heartbeat.
+    """
+    with obs.run("survey_measure_toas"):
+        return _survey_impl(list(specs), phShiftRes, nbrBins, varyAmps)
+
+
+def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
+    global _last_info
+    n_total = len(specs)
+    frames: list[pd.DataFrame | None] = [None] * n_total
+    errors: dict[str, str] = {}
+    demoted: dict[str, str] = {}
+    preps: dict[int, _Prepped] = {}
+    fallback: list[int] = []
+
+    for i, spec in enumerate(specs):
+        try:
+            preps[i] = _prep_source(spec, phShiftRes, nbrBins, varyAmps)
+        except Exception as exc:  # noqa: BLE001 — per-source failure domain
+            demoted[spec.name] = f"prep: {type(exc).__name__}: {exc}"
+            fallback.append(i)
+
+    from crimp_tpu.ops import autotune
+
+    max_events = max((p.max_seg for p in preps.values()), default=1)
+    resolved = autotune.resolve_multisource(n_total, max(max_events, 1))
+    batched = sorted(preps)
+    if not resolved["multisource"]:
+        for i in batched:
+            demoted[specs[i].name] = "knob: multisource off"
+        fallback.extend(batched)
+        batched = []
+
+    # group sources whose fits can share one compiled executable, then
+    # bucket each group by padded width (the whole-source generalization
+    # of fit_toas_bucketed's segment bucketing)
+    groups: dict[tuple, list[int]] = {}
+    for i in batched:
+        p = preps[i]
+        groups.setdefault((p.kind, p.cfg, int(p.tpl.n_comp)), []).append(i)
+
+    buckets: list[list[int]] = []
+    for members in groups.values():
+        for b in multisource.bucket_sources(
+            [max(preps[i].max_seg, 1) for i in members],
+            max_pad_ratio=resolved["max_pad"],
+            batch_cap=resolved["batch_cap"],
+        ):
+            buckets.append([members[j] for j in b])
+
+    done = 0
+    occ_used = occ_total = 0
+    obs.beat(0, n_total, label="sources", force=True)
+    for bucket in buckets:
+        ps = [preps[i] for i in bucket]
+        kind, cfg = ps[0].kind, ps[0].cfg
+        try:
+            phase_lists, t_refs = multisource.fold_sources(
+                [p.tm for p in ps], [p.seg_times for p in ps]
+            )
+            if kind in (profiles.CAUCHY, profiles.VONMISES):
+                phase_lists = [[ph * (2 * np.pi) for ph in pl]
+                               for pl in phase_lists]
+            results, slices = multisource.fit_sources(
+                kind, [p.tpl for p in ps], phase_lists,
+                [p.exposures for p in ps], cfg,
+            )
+            freqs_list = [spin_frequency_host(p.tm, t_refs[r])[0]
+                          for r, p in enumerate(ps)]
+            h_list = multisource.h_power_sources(
+                [p.seg_times for p in ps], freqs_list
+            )
+            width = max(max((p.max_seg for p in ps), default=1), 1)
+            for r, (i, p) in enumerate(zip(bucket, ps)):
+                res_r = {k: v[slices[r]] for k, v in results.items()}
+                frames[i] = _assemble_frame(p, t_refs[r], res_r, h_list[r]) \
+                    if p.seg_times else _empty_frame()
+                occ_used += sum(t.size for t in p.seg_times)
+                occ_total += width * len(p.seg_times)
+        except Exception as exc:  # noqa: BLE001 — a bucket-level failure
+            # demotes its sources to the per-source path, never the survey
+            logger.warning("survey bucket failed; falling back per source",
+                           exc_info=True)
+            for i in bucket:
+                demoted[specs[i].name] = f"bucket: {type(exc).__name__}: {exc}"
+            fallback.extend(bucket)
+        done += len(bucket)
+        obs.beat(done, n_total, label="sources")
+
+    n_batched = sum(1 for f in frames if f is not None)
+    for i in sorted(fallback):
+        try:
+            frames[i] = measure_source_toas(
+                specs[i], phShiftRes, nbrBins, varyAmps,
+                _prep=preps.get(i),
+            )
+        except Exception as exc:  # noqa: BLE001
+            errors[specs[i].name] = f"{type(exc).__name__}: {exc}"
+        done = min(done + 1, n_total)
+        obs.beat(done, n_total, label="sources")
+    obs.beat(n_total, n_total, label="sources", force=True)
+
+    occupancy = 100.0 * occ_used / occ_total if occ_total else 100.0
+    obs.gauge_set("bucket_occupancy_pct", round(occupancy, 2))
+    _last_info = {
+        "n_sources": n_total,
+        "n_batched": n_batched,
+        "n_fallback": len(fallback),
+        "n_failed": sum(1 for f in frames if f is None),
+        "bucket_count": len(buckets),
+        "occupancy_pct": round(occupancy, 2),
+        "demoted": demoted,
+        "errors": errors,
+    }
+    if demoted or errors:
+        logger.info("survey fallback summary: %s", _last_info)
+    return frames

@@ -52,8 +52,12 @@ def candidate_rate(kernel: str, sec, freqs, f0, df, n_trials: int,
     ``kernel`` selects the variant family being tuned: "grid" times the
     uniform-grid fast path (harmonic_sums_uniform, the same jitted core
     z2/h _power_grid call), "grid_mxu" the factorized matmul variant,
-    "general" the arbitrary-frequency blockwise kernel. Returns a
-    device-synchronized rate via best_rate.
+    "general" the arbitrary-frequency blockwise kernel, "multisource" the
+    survey batch engine's vmapped per-row H reduction — there the A/B
+    events reshape into rows of ``event_block`` events (the padded
+    per-source width) dispatched ``trial_block`` source rows at a time,
+    and the returned rate is source rows/s. Returns a device-synchronized
+    rate via best_rate.
     """
     import jax.numpy as jnp
 
@@ -75,6 +79,27 @@ def candidate_rate(kernel: str, sec, freqs, f0, df, n_trials: int,
         fn = lambda: search.harmonic_sums_1d(  # noqa: E731
             times, freqs_dev, nharm, event_block=event_block,
             trial_block=trial_block, poly=poly)[0]
+    elif kernel == "multisource":
+        n_src = max(1, len(sec) // int(event_block))
+        rows = jnp.asarray(
+            np.asarray(sec[: n_src * int(event_block)]).reshape(
+                n_src, int(event_block))
+        )
+        masks = jnp.ones(rows.shape, dtype=bool)
+        row_freqs = jnp.asarray(np.resize(np.asarray(freqs), n_src))
+        chunk = max(1, min(int(trial_block), n_src))
+
+        def fn():  # noqa: E731 — chunked like h_power_sources dispatches
+            outs = [
+                search.h_power_segments(rows[lo:lo + chunk],
+                                        masks[lo:lo + chunk],
+                                        row_freqs[lo:lo + chunk],
+                                        nharm=nharm)
+                for lo in range(0, n_src, chunk)
+            ]
+            return jnp.concatenate(outs)
+
+        return best_rate(fn, n_src, repeats=repeats)
     else:
         raise ValueError(f"unknown kernel variant {kernel!r}")
     return best_rate(fn, int(n_trials), repeats=repeats)

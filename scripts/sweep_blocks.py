@@ -12,8 +12,13 @@ the one-JSON-line-per-point output contract, and the Pallas tile sweep
 state, so that section stays inline).
 
 Usage: python scripts/sweep_blocks.py [--events 800000] [--trials 100000]
-       [--kernel grid|grid_mxu|general] [--no-poly] [--no-persist]
+       [--kernel grid|grid_mxu|general|multisource] [--no-poly] [--no-persist]
        [--pallas]  (also sweep the Pallas kernel's trial_tile/event_chunk)
+
+``--kernel multisource`` sweeps the survey batch engine's
+(event_block=padded per-source width, trial_block=source rows per
+dispatch) pair over the same grid; the winner persists under the
+"multisource" autotune key that ops/multisource resolves at dispatch.
 Run on the accelerator; CPU ratios do not transfer.
 """
 
@@ -42,7 +47,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--events", type=int, default=800_000)
     ap.add_argument("--trials", type=int, default=100_000)
-    ap.add_argument("--kernel", choices=("grid", "grid_mxu", "general"),
+    ap.add_argument("--kernel",
+                    choices=("grid", "grid_mxu", "general", "multisource"),
                     default="grid")
     ap.add_argument("--no-poly", action="store_true",
                     help="sweep the hardware-trig path instead of poly trig")

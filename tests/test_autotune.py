@@ -37,6 +37,9 @@ def tuner_cache(tmp_path, monkeypatch):
     monkeypatch.delenv("CRIMP_TPU_GRID_MXU", raising=False)
     monkeypatch.delenv("CRIMP_TPU_DELTA_FOLD", raising=False)
     monkeypatch.delenv("CRIMP_TPU_DELTA_FOLD_BUDGET", raising=False)
+    monkeypatch.delenv("CRIMP_TPU_MULTISOURCE", raising=False)
+    monkeypatch.delenv("CRIMP_TPU_MULTISOURCE_MAX_PAD", raising=False)
+    monkeypatch.delenv("CRIMP_TPU_MULTISOURCE_BATCH", raising=False)
     return path
 
 
@@ -553,6 +556,91 @@ class TestResolveDeltaFold:
 
         monkeypatch.setattr(autotune, "cached_delta_fold", boom)
         assert autotune.resolve_delta_fold(800_000)["delta_fold"] == 0
+
+class TestResolveMultisource:
+    """Survey batch engine knob resolution (CRIMP_TPU_MULTISOURCE +
+    _MAX_PAD + _BATCH): env hard override > cached bench A/B verdict
+    (unless autotune is off) > defaults. Unlike grid_mxu/delta_fold the
+    batched path defaults ON."""
+
+    def test_defaults_when_nothing_cached(self, tuner_cache):
+        assert autotune.resolve_multisource(100, 2000) == {
+            "multisource": 1,
+            "max_pad": autotune.MULTISOURCE_MAX_PAD_DEFAULT,
+            "batch_cap": 0}
+
+    def test_cached_verdict_used_in_auto_mode(self, tuner_cache):
+        autotune.store_multisource(100, 2000,
+                                   {"multisource": 0, "max_pad": 2.0},
+                                   tuner_cache)
+        out = autotune.resolve_multisource(100, 2000)
+        assert out["multisource"] == 0 and out["max_pad"] == 2.0
+        # size bucketing: a far-away workload keeps the default
+        assert autotune.resolve_multisource(100, 64)["multisource"] == 1
+
+    def test_off_mode_ignores_cache_but_honors_env(
+            self, tuner_cache, monkeypatch):
+        autotune.store_multisource(100, 2000, {"multisource": 0},
+                                   tuner_cache)
+        monkeypatch.setenv("CRIMP_TPU_AUTOTUNE", "0")
+        assert autotune.resolve_multisource(100, 2000)["multisource"] == 1
+        monkeypatch.setenv("CRIMP_TPU_MULTISOURCE", "0")
+        assert autotune.resolve_multisource(100, 2000)["multisource"] == 0
+
+    def test_env_beats_cached_verdict_both_directions(
+            self, tuner_cache, monkeypatch):
+        autotune.store_multisource(100, 2000,
+                                   {"multisource": 0, "max_pad": 2.0},
+                                   tuner_cache)
+        monkeypatch.setenv("CRIMP_TPU_MULTISOURCE", "1")
+        out = autotune.resolve_multisource(100, 2000)
+        assert out["multisource"] == 1
+        assert out["max_pad"] == 2.0  # un-overridden knob still cached
+        monkeypatch.setenv("CRIMP_TPU_MULTISOURCE_MAX_PAD", "8.0")
+        monkeypatch.setenv("CRIMP_TPU_MULTISOURCE_BATCH", "32")
+        assert autotune.resolve_multisource(100, 2000) == {
+            "multisource": 1, "max_pad": 8.0, "batch_cap": 32}
+
+    def test_env_malformed_raises(self, tuner_cache, monkeypatch):
+        for bad in ("2", "yes", "on", "-1"):
+            monkeypatch.setenv("CRIMP_TPU_MULTISOURCE", bad)
+            with pytest.raises(ValueError, match="CRIMP_TPU_MULTISOURCE"):
+                autotune.resolve_multisource(100, 2000)
+        monkeypatch.delenv("CRIMP_TPU_MULTISOURCE")
+        for bad in ("zero", "0", "-4", "inf"):
+            monkeypatch.setenv("CRIMP_TPU_MULTISOURCE_MAX_PAD", bad)
+            with pytest.raises(ValueError,
+                               match="CRIMP_TPU_MULTISOURCE_MAX_PAD"):
+                autotune.resolve_multisource(100, 2000)
+        monkeypatch.delenv("CRIMP_TPU_MULTISOURCE_MAX_PAD")
+        monkeypatch.setenv("CRIMP_TPU_MULTISOURCE_BATCH", "-2")
+        with pytest.raises(ValueError, match="CRIMP_TPU_MULTISOURCE_BATCH"):
+            autotune.resolve_multisource(100, 2000)
+
+    def test_malformed_entry_rejected(self, tuner_cache):
+        autotune.store_multisource(100, 2000, {"multisource": "yes"},
+                                   tuner_cache)
+        assert autotune.cached_multisource(100, 2000) is None
+        assert autotune.resolve_multisource(100, 2000)["multisource"] == 1
+
+    def test_enable_key_distinct_from_block_entries(self, tuner_cache):
+        # the on/off verdict and the (event_block, source_block) pair live
+        # under different kernel names; storing one must not shadow the other
+        assert autotune.multisource_cache_key(100, 2000) != \
+            autotune.cache_key("multisource", False, 2000, 100)
+
+    def test_resolve_blocks_accepts_multisource_kernel(self, tuner_cache):
+        key = autotune.cache_key("multisource", False, 4096, 128)
+        autotune._store_entry(key, {"event_block": 4096, "trial_block": 64},
+                              tuner_cache)
+        assert autotune.resolve_blocks("multisource", 4096, 128) == (4096, 64)
+
+    def test_multisource_blocks_default_to_module_statics(self, tuner_cache):
+        from crimp_tpu.ops import multisource
+
+        assert autotune.resolve_blocks("multisource", 4096, 128) == (
+            multisource.MULTISOURCE_EVENT_BLOCK,
+            multisource.MULTISOURCE_SOURCE_BLOCK)
 
     def test_resolve_blocks_accepts_grid_mxu_kernel(self, tuner_cache,
                                                     monkeypatch):

@@ -201,13 +201,43 @@ class TestFoldCache:
         assert deltafold.last_fold_info()["mode"] == "exact"
 
     def test_nonlinear_change_invalidates(self):
+        # a nonlinear move lands on a DISTINCT cache key (the model sha is
+        # part of fold_key), so it is a clean miss — not a same-key
+        # eviction of the old product
         segs = _segments(n_per=500)
         anchored.fold_segments(timing.from_dict(BASE), segs, delta_fold=1)
         moved = timing.from_dict({**BASE, "GLEP_1": 58401.0})
         anchored.fold_segments(moved, segs, delta_fold=1)
         info = deltafold.last_fold_info()
         assert info["mode"] == "exact"
-        assert info["fallback"] == "nonlinear"
+        assert "fallback" not in info
+
+    def test_model_identity_in_key_prevents_collisions(self):
+        # regression (round 8): two sources with IDENTICAL event
+        # byte-streams but different models must occupy distinct cache
+        # slots — alternating between them used to evict each other's
+        # product on every fold
+        segs = _segments(n_per=500)
+        tm_a = timing.from_dict(BASE)
+        tm_b = timing.from_dict({**BASE, "PEPOCH": BASE["PEPOCH"] + 30.0})
+        sha_a = deltafold.nonlinear_sha(tm_a)
+        sha_b = deltafold.nonlinear_sha(tm_b)
+        times = np.concatenate(segs)
+        sizes = [s.size for s in segs]
+        t_ref = np.asarray([s.mean() for s in segs])
+        assert deltafold.fold_key(times, sizes, t_ref, model_sha=sha_a) != \
+            deltafold.fold_key(times, sizes, t_ref, model_sha=sha_b)
+        # and a distinct tag namespaces even identical models
+        assert deltafold.fold_key(times, sizes, t_ref, model_sha=sha_a) != \
+            deltafold.fold_key(times, sizes, t_ref, model_sha=sha_a, tag="src1")
+        ph_a, _ = anchored.fold_segments(tm_a, segs, delta_fold=1)
+        anchored.fold_segments(tm_b, segs, delta_fold=1)
+        ph_a2, _ = anchored.fold_segments(tm_a, segs, delta_fold=1)
+        # pre-fix this alternation was an eviction thrash: the third fold
+        # re-folded exactly; now it is a pure bit-identical cache hit
+        assert deltafold.last_fold_info()["mode"] == "cache"
+        for a, b in zip(ph_a, ph_a2):
+            assert np.array_equal(a, b)
 
     def test_cache_off_never_stores(self, monkeypatch):
         monkeypatch.setenv("CRIMP_TPU_FOLD_CACHE", "0")

@@ -1,0 +1,222 @@
+"""Survey batch engine tests (ops/multisource + pipelines/survey).
+
+Pins the parity contract documented in docs/performance.md "Survey mode":
+
+- the stacked fold is per-event elementwise, so batched phases are
+  bit-identical to the single-source anchored fold ALWAYS — including
+  ragged glitch/wave counts absorbed by inert padding rows;
+- the template-fit columns reduce in f64 and stay bitwise even across
+  ragged bucket widths; the per-ToA H-test trig sums run in f32 over the
+  padded event axis, so ragged widths re-tree the f32 sum (~1e-7
+  relative) while equal per-interval counts (exact padding) are bitwise
+  on every output column.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from crimp_tpu.ops import anchored, multisource
+from crimp_tpu.pipelines import survey
+
+TPL = {"model": "fourier", "nbrComp": 2, "norm": 1.0,
+       "amp_1": 0.3, "amp_2": 0.1, "ph_1": 0.2, "ph_2": 0.05}
+
+
+def _timing_dict(i: int, glitch: bool = False, wave: bool = False) -> dict:
+    tm = {"PEPOCH": 58000.0, "F0": 0.14 + 0.003 * (i % 53), "F1": -1e-13}
+    if glitch:
+        tm.update({"GLEP_1": 58003.0, "GLF0_1": 1e-7, "GLPH_1": 0.1,
+                   "GLF0D_1": 5e-8, "GLTD_1": 2.0})
+    if wave:
+        tm.update({"WAVEEPOCH": 58000.0, "WAVE_OM": 0.7,
+                   "WAVE1": {"A": 1e-4, "B": -2e-4},
+                   "WAVE2": {"A": 5e-5, "B": 3e-5}})
+    return tm
+
+
+def make_spec(i, rng, n_per=None, n_ev=240, n_int=2, glitch=False,
+              name=None) -> survey.SourceSpec:
+    """One in-memory synthetic source. ``n_per`` pins the per-interval
+    event count exactly (-> equal pad widths -> bitwise contract);
+    ``n_ev`` scatters events freely across the span (ragged widths)."""
+    edges = np.linspace(58000.0, 58008.0, n_int + 1)
+    if n_per is not None:
+        times = np.sort(np.concatenate([
+            rng.uniform(lo + 1e-6, hi - 1e-6, n_per)
+            for lo, hi in zip(edges[:-1], edges[1:])
+        ]))
+    else:
+        times = np.sort(rng.uniform(58000.0, 58008.0, n_ev))
+    iv = pd.DataFrame({
+        "ToA_tstart": edges[:-1], "ToA_tend": edges[1:],
+        "ToA_exposure": np.full(n_int, (edges[1] - edges[0]) * 86400.0),
+    })
+    return survey.SourceSpec(name=name or f"src{i}", times=times,
+                             timing_model=_timing_dict(i, glitch=glitch),
+                             template=dict(TPL), intervals=iv)
+
+
+def _assert_frames_match(batched, solo, ragged: bool, ctx=""):
+    """Column-by-column parity per the documented contract."""
+    for col in survey.SURVEY_TOA_COLUMNS:
+        a, b = batched[col].to_numpy(), solo[col].to_numpy()
+        if ragged and col == "Hpower":
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6,
+                                       err_msg=f"{ctx}:{col}")
+        else:
+            assert np.array_equal(a, b), (ctx, col, a, b)
+
+
+class TestStackedFoldParity:
+    """fold_sources must be bitwise identical per source to the
+    single-source anchored fold, whatever the batch composition."""
+
+    def test_bitwise_vs_fold_segments_ragged_glitch_wave(self):
+        rng = np.random.RandomState(11)
+        # deliberately ragged model STRUCTURE: 0/1/2 glitches, 0/2 waves —
+        # stack_models pads the short ones with inert rows (+0.0 exactly)
+        tms = [
+            _timing_dict(0),
+            _timing_dict(1, glitch=True),
+            _timing_dict(2, glitch=True, wave=True),
+            {"PEPOCH": 58000.0, "F0": 0.2, "F1": -2e-13,
+             "GLEP_1": 58002.0, "GLF0_1": 2e-7,
+             "GLEP_2": 58005.0, "GLF0_2": -1e-7, "GLF1_2": 1e-15},
+        ]
+        seg_lists = [
+            [np.sort(rng.uniform(58000.0 + 2.0 * s, 58002.0 + 2.0 * s, n))
+             for s, n in enumerate(sizes)]
+            for sizes in ([120, 40], [77], [300, 5, 64], [33, 200])
+        ]
+        phase_lists, t_refs = multisource.fold_sources(tms, seg_lists)
+        for i, (tm, segs) in enumerate(zip(tms, seg_lists)):
+            ref_ph, ref_t = anchored.fold_segments(tm, segs, delta_fold=0)
+            assert np.array_equal(np.asarray(t_refs[i]), np.asarray(ref_t))
+            for s, (got, want) in enumerate(zip(phase_lists[i], ref_ph)):
+                assert np.array_equal(np.asarray(got), np.asarray(want)), \
+                    (i, s)
+
+    def test_explicit_t_ref_honored(self):
+        rng = np.random.RandomState(12)
+        segs = [np.sort(rng.uniform(58000.0, 58004.0, 90))]
+        t_ref = np.array([58001.25])
+        phase_lists, t_refs = multisource.fold_sources(
+            [_timing_dict(0)], [segs], t_ref_list=[t_ref])
+        ref_ph, _ = anchored.fold_segments(_timing_dict(0), segs,
+                                           t_ref_mjd=t_ref, delta_fold=0)
+        assert np.array_equal(np.asarray(t_refs[0]), t_ref)
+        assert np.array_equal(np.asarray(phase_lists[0][0]),
+                              np.asarray(ref_ph[0]))
+
+
+class TestBucketSources:
+    def test_single_source(self):
+        assert multisource.bucket_sources([37]) == [[0]]
+
+    def test_empty(self):
+        assert multisource.bucket_sources([]) == []
+
+    def test_homogeneous_collapses_to_one_bucket(self):
+        assert multisource.bucket_sources([100] * 6) == [list(range(6))]
+
+    def test_max_pad_ratio_splits_disparate_sizes(self):
+        buckets = multisource.bucket_sources([8, 8, 4096], max_pad_ratio=4.0)
+        assert buckets == [[0, 1], [2]]
+        # a huge ratio lets everything merge back into one dispatch
+        assert multisource.bucket_sources([8, 8, 4096],
+                                          max_pad_ratio=1e6) == [[0, 1, 2]]
+
+    def test_batch_cap_splits_buckets(self):
+        buckets = multisource.bucket_sources([64] * 8, batch_cap=3)
+        assert [len(b) for b in buckets] == [3, 3, 2]
+        assert sorted(i for b in buckets for i in b) == list(range(8))
+
+
+class TestSurveyParity:
+    def test_exact_padding_is_bitwise_every_column(self):
+        rng = np.random.RandomState(21)
+        specs = [make_spec(i, rng, n_per=70, glitch=(i == 1))
+                 for i in range(6)]
+        frames = survey.survey_measure_toas(specs, phShiftRes=200)
+        assert survey.last_survey_info()["n_batched"] == 6
+        for i, spec in enumerate(specs):
+            solo = survey.measure_source_toas(spec, phShiftRes=200)
+            _assert_frames_match(frames[i], solo, ragged=False, ctx=spec.name)
+
+    @pytest.mark.slow
+    def test_hundred_sources_match_loop_with_bad_source_isolated(self):
+        rng = np.random.RandomState(22)
+        specs = [make_spec(i, rng, n_ev=int(rng.randint(60, 120)),
+                           glitch=(i % 7 == 0)) for i in range(100)]
+        bad = make_spec(999, rng, n_ev=40, name="badsrc")
+        bad.times = bad.times[bad.times < 58004.0]  # last interval empty
+        specs.insert(57, bad)
+
+        frames = survey.survey_measure_toas(specs, phShiftRes=200)
+        info = survey.last_survey_info()
+        assert len(frames) == 101
+        assert frames[57] is None  # fallback failed too -> isolated, not fatal
+        assert "badsrc" in info["errors"]
+        assert "badsrc" in info["demoted"]
+        assert info["n_batched"] == 100
+        assert info["n_failed"] == 1
+        assert info["bucket_count"] >= 1
+        for i, spec in enumerate(specs):
+            if i == 57:
+                continue
+            solo = survey.measure_source_toas(spec, phShiftRes=200)
+            _assert_frames_match(frames[i], solo, ragged=True, ctx=spec.name)
+
+    def test_batch_of_one(self):
+        rng = np.random.RandomState(23)
+        spec = make_spec(0, rng, n_ev=150, n_int=3)
+        frames = survey.survey_measure_toas([spec], phShiftRes=200)
+        assert survey.last_survey_info()["n_batched"] == 1
+        solo = survey.measure_source_toas(spec, phShiftRes=200)
+        # a batch of one pads to its own width -> exact padding -> bitwise
+        _assert_frames_match(frames[0], solo, ragged=False, ctx=spec.name)
+
+    def test_empty_source_yields_empty_frame(self):
+        rng = np.random.RandomState(24)
+        empty = survey.SourceSpec(
+            name="empty", times=np.array([58001.0, 58002.0]),
+            timing_model=_timing_dict(0), template=dict(TPL),
+            intervals=pd.DataFrame({"ToA_tstart": [], "ToA_tend": [],
+                                    "ToA_exposure": []}),
+        )
+        frames = survey.survey_measure_toas([empty, make_spec(1, rng)],
+                                            phShiftRes=200)
+        assert list(frames[0].columns) == survey.SURVEY_TOA_COLUMNS
+        assert len(frames[0]) == 0
+        assert len(frames[1]) > 0
+        assert survey.last_survey_info()["n_failed"] == 0
+
+    def test_knob_off_routes_everything_to_the_loop(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_MULTISOURCE", "0")
+        rng = np.random.RandomState(25)
+        specs = [make_spec(i, rng, n_ev=100) for i in range(3)]
+        frames = survey.survey_measure_toas(specs, phShiftRes=200)
+        info = survey.last_survey_info()
+        assert info["n_batched"] == 0
+        assert info["n_fallback"] == 3
+        assert all(info["demoted"][s.name] == "knob: multisource off"
+                   for s in specs)
+        monkeypatch.delenv("CRIMP_TPU_MULTISOURCE")
+        for spec, frame in zip(specs, frames):
+            solo = survey.measure_source_toas(spec, phShiftRes=200)
+            _assert_frames_match(frame, solo, ragged=False, ctx=spec.name)
+
+    def test_max_pad_env_tightens_buckets(self, monkeypatch):
+        rng = np.random.RandomState(26)
+        # caps 64 and 128 merge under the default 4.0 ratio (128 < 4*40)
+        # and split under 1.0 (128 > 40)
+        specs = [make_spec(i, rng, n_per=n) for i, n in
+                 enumerate([40, 40, 100, 100])]
+        survey.survey_measure_toas(specs, phShiftRes=200)
+        merged = survey.last_survey_info()["bucket_count"]
+        monkeypatch.setenv("CRIMP_TPU_MULTISOURCE_MAX_PAD", "1.0")
+        survey.survey_measure_toas(specs, phShiftRes=200)
+        tight = survey.last_survey_info()["bucket_count"]
+        assert tight > merged
+        assert survey.last_survey_info()["n_batched"] == 4

@@ -468,3 +468,76 @@ class TestShardedGridMXU:
             reseed=64, mxu_bf16=False))
         assert np.max(np.abs(fact - exact)) < 0.01 * np.sqrt(4.0 * 2)
         assert int(np.argmax(fact)) == int(np.argmax(exact))
+
+
+class TestShardedMultisource:
+    """Source-axis data parallelism of the survey batch engine: the
+    stacked fold shards whole source rows across the 8 virtual devices
+    (pure data parallelism, no collective touches any row's reduction),
+    so sharded output must be BITWISE equal to the opted-out path —
+    including when the fleet size is not a device multiple and
+    _maybe_shard_sources pads with inert rows."""
+
+    def _fleet(self, n_sources):
+        rng = np.random.RandomState(9)
+        tms, seg_lists = [], []
+        for i in range(n_sources):
+            tm = {"PEPOCH": 58000.0, "F0": 0.14 + 0.003 * i, "F1": -1e-13}
+            if i % 3 == 0:  # ragged model structure rides along
+                tm.update({"GLEP_1": 58002.0, "GLF0_1": 1e-7})
+            tms.append(tm)
+            seg_lists.append([
+                np.sort(rng.uniform(58000.0 + 2 * s, 58002.0 + 2 * s,
+                                    int(rng.randint(40, 160))))
+                for s in range(2)
+            ])
+        return tms, seg_lists
+
+    @pytest.mark.parametrize("n_sources", [8, 11])
+    def test_fold_sources_sharded_bitmatches_opt_out(self, n_sources,
+                                                     monkeypatch):
+        from crimp_tpu.ops import multisource
+
+        tms, seg_lists = self._fleet(n_sources)
+        monkeypatch.delenv("CRIMP_TPU_SHARD", raising=False)
+        sharded, t_sh = multisource.fold_sources(tms, seg_lists)
+        monkeypatch.setenv("CRIMP_TPU_SHARD", "0")
+        plain, t_pl = multisource.fold_sources(tms, seg_lists)
+        for i in range(n_sources):
+            np.testing.assert_array_equal(np.asarray(t_sh[i]),
+                                          np.asarray(t_pl[i]))
+            for a, b in zip(sharded[i], plain[i]):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_survey_sharded_bitmatches_opt_out(self, monkeypatch):
+        import pandas as pd
+
+        from crimp_tpu.pipelines import survey
+
+        rng = np.random.RandomState(10)
+        edges = np.linspace(58000.0, 58006.0, 3)
+        specs = []
+        for i in range(9):  # 9 sources on 8 devices -> inert-row padding
+            specs.append(survey.SourceSpec(
+                name=f"s{i}",
+                times=np.sort(rng.uniform(58000.0, 58006.0, 120)),
+                timing_model={"PEPOCH": 58000.0, "F0": 0.15 + 0.002 * i,
+                              "F1": -1e-13},
+                template={"model": "fourier", "nbrComp": 2, "norm": 1.0,
+                          "amp_1": 0.3, "amp_2": 0.1, "ph_1": 0.2,
+                          "ph_2": 0.05},
+                intervals=pd.DataFrame({
+                    "ToA_tstart": edges[:-1], "ToA_tend": edges[1:],
+                    "ToA_exposure": np.full(2, (edges[1] - edges[0]) * 86400.0),
+                }),
+            ))
+        monkeypatch.delenv("CRIMP_TPU_SHARD", raising=False)
+        frames_sh = survey.survey_measure_toas(specs, phShiftRes=200)
+        assert survey.last_survey_info()["n_batched"] == 9
+        monkeypatch.setenv("CRIMP_TPU_SHARD", "0")
+        frames_pl = survey.survey_measure_toas(specs, phShiftRes=200)
+        assert survey.last_survey_info()["n_batched"] == 9
+        for a, b in zip(frames_sh, frames_pl):
+            for col in survey.SURVEY_TOA_COLUMNS:
+                np.testing.assert_array_equal(a[col].to_numpy(),
+                                              b[col].to_numpy())
