@@ -34,6 +34,8 @@ RULES: dict[str, str] = {
     "GL003": "knob-registry consistency (crimp_tpu/knobs.py <-> env reads <-> docs <-> numeric_mode)",
     "GL004": "dtype discipline: longdouble/float128 confined to host-side anchor modules",
     "GL005": "order-sensitive reductions in sharded/parity-pinned modules",
+    "GL006": "failure-domain discipline: bare `except Exception` must classify "
+             "through resilience.taxonomy or carry a waiver reason",
 }
 
 _RULE_LIST = r"GL\d{3}(?:\s*,\s*GL\d{3})*"
@@ -198,6 +200,7 @@ DEFAULT_GL004_ALLOWLIST = (
 )
 
 DEFAULT_GL005_MODULES = ("crimp_tpu/parallel/",)
+DEFAULT_GL006_MODULES = ("crimp_tpu/",)
 
 
 @dataclasses.dataclass
@@ -212,6 +215,7 @@ class Config:
     knobs_rel: str = "crimp_tpu/knobs.py"  # the one sanctioned env-read site
     gl004_allowlist: tuple[str, ...] = DEFAULT_GL004_ALLOWLIST
     gl005_modules: tuple[str, ...] = DEFAULT_GL005_MODULES
+    gl006_modules: tuple[str, ...] = DEFAULT_GL006_MODULES
     rules: tuple[str, ...] | None = None  # None = all
 
     def resolved_registry(self) -> dict:

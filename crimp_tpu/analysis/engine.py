@@ -19,6 +19,7 @@ RULE_FUNCS = {
     "GL003": knobcheck.rule_gl003,
     "GL004": rules.rule_gl004,
     "GL005": rules.rule_gl005,
+    "GL006": rules.rule_gl006,
 }
 
 

@@ -1,4 +1,4 @@
-"""graftlint rules GL001/GL002/GL004/GL005 (GL003 lives in knobcheck.py).
+"""graftlint rules GL001/GL002/GL004/GL005/GL006 (GL003 lives in knobcheck.py).
 
 Each rule is a function ``(cfg, sources, project) -> list[Finding]``
 over the parsed scan set. The rules encode invariants the repo's kernel
@@ -23,6 +23,12 @@ GL005  order-sensitive reductions — matmul/dot/einsum/axis-sums in the
        waiver stating the fixed-order/parity argument (the PR-4 lesson:
        XLA re-tiles matvec reductions per shape, so a sharded matvec
        broke the 8-device bitwise pin).
+GL006  failure-domain discipline — a bare ``except Exception`` inside
+       crimp_tpu/ must route the exception through
+       ``resilience.classify``/``error_record`` (so retry/degradation
+       policy sees a FailureKind, not a swallowed traceback), bare-
+       re-raise it, or carry a waiver stating why this handler is a
+       deliberate swallow domain (telemetry guards are the baseline).
 """
 
 from __future__ import annotations
@@ -232,4 +238,57 @@ def rule_gl005(cfg: Config, sources: dict[str, SourceFile],
                     "8-device bitwise pin once (parallel/mesh.py); use "
                     "fixed-order accumulation or waive with the parity "
                     "argument"))
+    return out
+
+
+# -- GL006 -------------------------------------------------------------------
+
+# Calls whose dotted tail proves the handler classified the failure:
+# resilience.classify(exc) or resilience.error_record(exc) (the latter
+# embeds classify and is the info-dict form the survey uses).
+CLASSIFY_TAILS = {"classify", "error_record"}
+
+
+def _gl006_broad(type_node) -> bool:
+    """Whether an ExceptHandler's type catches everything."""
+    if type_node is None:
+        return True  # bare `except:`
+    elts = type_node.elts if isinstance(type_node, ast.Tuple) else [type_node]
+    return any(isinstance(n, ast.Name)
+               and n.id in ("Exception", "BaseException") for n in elts)
+
+
+def _gl006_classifies(handler: ast.ExceptHandler) -> bool:
+    for sub in ast.walk(handler):
+        if isinstance(sub, ast.Call) and call_tail(sub.func) in CLASSIFY_TAILS:
+            return True
+        if isinstance(sub, ast.Raise) and sub.exc is None:
+            # a bare re-raise keeps the exception in flight — the caller's
+            # failure domain owns classification
+            return True
+    return False
+
+
+def rule_gl006(cfg: Config, sources: dict[str, SourceFile],
+               project: Project) -> list[Finding]:
+    out: list[Finding] = []
+    for rel, src in sources.items():
+        if not src.is_python or src.tree is None:
+            continue
+        if not any(rel == m or rel.startswith(m) for m in cfg.gl006_modules):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _gl006_broad(node.type):
+                continue
+            if _gl006_classifies(node):
+                continue
+            out.append(Finding(
+                "GL006", rel, node.lineno,
+                "bare `except Exception` without failure classification — "
+                "route it through resilience.classify/error_record so "
+                "retry/degradation policy sees its FailureKind, or waive "
+                "with the reason this handler is a deliberate swallow "
+                "domain"))
     return out

@@ -183,6 +183,19 @@ REGISTRY: dict[str, Knob] = _build_registry((
     Knob("CRIMP_TPU_TIER_FORCE_CPU", "unset", "bool",
          consumer="tests/test_tpu_tier.py + scripts/onchip_session.sh",
          doc="run the tier's workloads at tiny scale on CPU (dry-run plumbing)"),
+    # -- resilience ---------------------------------------------------------
+    Knob("CRIMP_TPU_FAULTS", "unset (injector disarmed)", "str",
+         consumer="crimp_tpu/resilience/faultinject.py",
+         doc="deterministic fault plan 'kind:point:n,...' for chaos tests "
+             "(test instrumentation; never set in production)"),
+    Knob("CRIMP_TPU_RETRIES", "1", "int",
+         consumer="crimp_tpu/resilience/policy.py",
+         doc="same-mode retries after a transient classified failure "
+             "(a successful retry is bit-identical)"),
+    Knob("CRIMP_TPU_BACKOFF_S", "0.05", "float",
+         consumer="crimp_tpu/resilience/policy.py",
+         doc="base retry backoff; doubles per attempt with deterministic "
+             "jitter (0 disables sleeping)"),
 ))
 
 

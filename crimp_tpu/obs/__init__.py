@@ -43,6 +43,7 @@ from crimp_tpu.obs.core import (  # noqa: F401
     enabled,
     gauge_set,
     last_manifest_path,
+    mark_degraded,
     record_numeric_mode,
     record_span,
     run,

@@ -27,12 +27,15 @@ from __future__ import annotations
 
 import contextlib
 import json
+import logging
 import os
 import sys
 import threading
 import time
 
 from crimp_tpu import knobs
+
+logger = logging.getLogger("crimp_tpu.obs")
 
 OBS_SCHEMA = "crimp_tpu.obs"
 OBS_SCHEMA_VERSION = 1
@@ -169,6 +172,7 @@ class RunRecorder:
         self.gauges: dict[str, float] = {}
         self.numeric_mode: dict | None = None
         self.error: str | None = None
+        self.degraded: list[str] = []
         self.spans: list[dict] = [{
             "name": self.name, "kind": "run", "t0_s": 0.0, "dur_s": None,
             "parent": None, "thread": 0, "attrs": dict(attrs),
@@ -176,10 +180,15 @@ class RunRecorder:
         self._threads: dict[int, int] = {threading.get_ident(): 0}
         self._events = None
         self.hb = None  # lazy per-run heartbeat state (obs/heartbeat.py)
-        os.makedirs(self.dir, exist_ok=True)
-        if knobs.env_onoff("CRIMP_TPU_OBS_EVENTS") is not False:
-            path = os.path.join(self.dir, self.run_id + ".events.jsonl")
-            self._events = open(path, "a", encoding="utf-8")
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            if knobs.env_onoff("CRIMP_TPU_OBS_EVENTS") is not False:
+                path = os.path.join(self.dir, self.run_id + ".events.jsonl")
+                self._events = open(path, "a", encoding="utf-8")
+        except OSError:
+            # Telemetry must never fail a run: a read-only or full obs dir
+            # just means no events stream for this run.
+            self._note_write_error("events open")
         # The knob snapshot rides in run_start so a salvaged manifest can
         # carry the same environment record a finalized one does.
         self._emit({"ev": "run_start", "schema": OBS_SCHEMA,
@@ -194,6 +203,21 @@ class RunRecorder:
         with _LOCK:
             return self._threads.setdefault(ident, len(self._threads))
 
+    def _note_write_error(self, where: str) -> None:
+        """Record a telemetry write failure and stop writing for the run."""
+        with _LOCK:
+            if self._events is not None:
+                try:
+                    self._events.close()
+                except OSError:
+                    pass
+                self._events = None
+            self.counters["telemetry_write_errors"] = \
+                self.counters.get("telemetry_write_errors", 0) + 1
+        logger.warning(
+            "obs %s write failed (ENOSPC/read-only?); further telemetry "
+            "writes disabled for run %s", where, self.run_id)
+
     def _emit(self, event: dict) -> None:
         if self._events is None:
             return
@@ -201,9 +225,12 @@ class RunRecorder:
             if self._events is None:  # closed by finalize on another thread
                 return
             event.setdefault("t_s", round(time.perf_counter() - self.t0, 6))
-            json.dump(event, self._events, default=str)
-            self._events.write("\n")
-            self._events.flush()
+            try:
+                json.dump(event, self._events, default=str)
+                self._events.write("\n")
+                self._events.flush()
+            except OSError:
+                self._note_write_error("events")
 
     def manifest(self) -> dict:
         """The manifest document (schema contract in docs/observability.md)."""
@@ -215,6 +242,8 @@ class RunRecorder:
             "t_start_unix": round(self.t0_unix, 3),
             "wall_s": self.spans[0]["dur_s"],
             "error": self.error,
+            "degraded": bool(self.degraded),
+            "degradations": list(self.degraded),
             "platform": _platform_identity(),
             "knobs": _knob_snapshot(),
             "numeric_mode": self.numeric_mode,
@@ -224,24 +253,36 @@ class RunRecorder:
             "spans": list(self.spans),
         }
 
-    def finalize(self) -> str:
-        """Close the root span, write the manifest atomically, return its path."""
+    def finalize(self) -> str | None:
+        """Close the root span, write the manifest atomically, return its path.
+
+        Returns None (and logs) when the obs dir rejects the write — a run
+        that computed correctly must not die on its telemetry epilogue.
+        """
         with _LOCK:
             if self.spans[0]["dur_s"] is None:
                 self.spans[0]["dur_s"] = round(time.perf_counter() - self.t0, 6)
             doc = self.manifest()
             path = os.path.join(self.dir, self.run_id + ".manifest.json")
             tmp = path + ".tmp"
-            with open(tmp, "w", encoding="utf-8") as fh:
-                json.dump(doc, fh, indent=1, sort_keys=False, default=str)
-                fh.write("\n")
-            os.replace(tmp, path)
+            try:
+                with open(tmp, "w", encoding="utf-8") as fh:
+                    json.dump(doc, fh, indent=1, sort_keys=False, default=str)
+                    fh.write("\n")
+                os.replace(tmp, path)
+            except OSError:
+                self._note_write_error("manifest")
+                return None
             if self._events is not None:
                 self._emit({"ev": "run_end", "run_id": self.run_id,
                             "wall_s": self.spans[0]["dur_s"],
                             "manifest": path, "error": self.error})
-                self._events.close()
-                self._events = None
+                if self._events is not None:
+                    try:
+                        self._events.close()
+                    except OSError:
+                        pass
+                    self._events = None
         return path
 
 
@@ -283,13 +324,13 @@ def _platform_identity() -> dict:
                        "kind": getattr(d, "device_kind", "")}
                 try:
                     stats = d.memory_stats()
-                except Exception:  # noqa: BLE001 — CPU devices have none
+                except Exception:  # noqa: BLE001 — CPU devices have none  # graftlint: disable=GL006 (telemetry guard: memory_stats is absent on CPU backends; obs cannot import resilience without a cycle)
                     stats = None
                 if stats:
                     dev["bytes_in_use"] = stats.get("bytes_in_use")
                     dev["bytes_limit"] = stats.get("bytes_limit")
                 out["devices"].append(dev)
-    except Exception:  # noqa: BLE001 — identity is best-effort telemetry
+    except Exception:  # noqa: BLE001 — identity is best-effort telemetry  # graftlint: disable=GL006 (telemetry guard: platform identity must never fail a run; obs cannot import resilience without a cycle)
         pass
     return out
 
@@ -299,7 +340,7 @@ def _compile_snapshot() -> dict | None:
     try:
         from crimp_tpu.utils import profiling
         return profiling.compile_counters()
-    except Exception:  # noqa: BLE001 — telemetry must never fail a run
+    except Exception:  # noqa: BLE001 — telemetry must never fail a run  # graftlint: disable=GL006 (telemetry guard: compile-cache counters are optional; obs cannot import resilience without a cycle)
         return None
 
 
@@ -388,6 +429,21 @@ def gauge_set(name: str, value: float) -> None:
     with _LOCK:
         rec.gauges[name] = value
     rec._emit({"ev": "gauge", "k": str(name), "v": value})
+
+
+def mark_degraded(reason: str) -> None:
+    """Stamp the active run degraded (a ladder rung was taken).
+
+    No-op when no run is active. The reasons accumulate in the manifest's
+    ``degradations`` list and flip its ``degraded`` flag; the perf ledger
+    excludes degraded rounds from the green baseline.
+    """
+    rec = _RUN
+    if rec is None:
+        return
+    with _LOCK:
+        rec.degraded.append(str(reason))
+    rec._emit({"ev": "degraded", "reason": str(reason)})
 
 
 def record_numeric_mode(mode: dict) -> None:

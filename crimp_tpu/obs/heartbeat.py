@@ -139,11 +139,18 @@ def beat(done: float, total: float | None, label: str | None = None,
                **{k: doc[k] for k in ("t_s", "label", "done", "total",
                                       "frac", "rate_per_s", "eta_s",
                                       "span", "backend")}})
-    tmp = hb["path"] + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump(doc, fh, indent=1, default=str)
-        fh.write("\n")
-    os.replace(tmp, hb["path"])
+    if hb["path"] is not None:
+        tmp = hb["path"] + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1, default=str)
+                fh.write("\n")
+            os.replace(tmp, hb["path"])
+        except OSError:
+            # ENOSPC/read-only obs dir mid-scan: a heartbeat must never
+            # kill the run. Stop writing the sidecar, keep computing.
+            hb["path"] = None
+            rec._note_write_error("heartbeat sidecar")
     return doc
 
 

@@ -24,6 +24,10 @@ Classification vocabulary (one per entry):
                      forced CPU; excluded, but not an outage signal).
 - ``carried``      — a carry-forward record (bench re-emitting the last
                      real measurement); never baseline material.
+- ``degraded``     — the run completed only by taking a resilience
+                     ladder rung (manifest ``degraded`` flag, or a bench
+                     record stamped ``degraded``); its numbers reflect a
+                     lower rung, so it never feeds the green baseline.
 - ``failed``       — nonzero rc or no parseable record (r1's crash, r5's
                      rc=124 polling timeout).
 - ``unknown``      — a parsed record from before the ``platform`` stamp
@@ -75,6 +79,8 @@ def classify(record: dict | None, rc: int | None = None) -> str:
         return "failed"
     if record.get("carried"):
         return "carried"
+    if record.get("degraded"):
+        return "degraded"
     platform = record.get("platform")
     if platform == "cpu":
         if record.get("platform_fallback") is False:
@@ -131,6 +137,8 @@ def _entry_from_manifest(doc: dict, source: str) -> dict:
         cls = "cpu_fallback"
     else:
         cls = "unknown"
+    if doc.get("degraded"):
+        cls = "degraded"  # completed on a ladder rung, not the normal path
     if doc.get("salvaged"):
         cls = "failed"  # a killed run's lower-bound walls are not baselines
     metrics = {}

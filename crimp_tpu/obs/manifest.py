@@ -64,6 +64,14 @@ def validate_manifest(doc) -> list[str]:
         problems.append(
             f"'heartbeat' is {type(doc['heartbeat']).__name__}, "
             "expected object/null")
+    # optional extensions (PR-9 resilience layer; pre-PR manifests lack them)
+    if "degraded" in doc and not isinstance(doc["degraded"], bool):
+        problems.append(
+            f"'degraded' is {type(doc['degraded']).__name__}, expected bool")
+    if "degradations" in doc and not isinstance(doc["degradations"], list):
+        problems.append(
+            f"'degradations' is {type(doc['degradations']).__name__}, "
+            "expected list")
     if doc.get("schema") not in (None, OBS_SCHEMA):
         problems.append(f"schema is {doc.get('schema')!r}, expected {OBS_SCHEMA!r}")
     ver = doc.get("schema_version")

@@ -53,7 +53,8 @@ import logging
 import pathlib
 import time
 
-from crimp_tpu import knobs, obs
+from crimp_tpu import knobs, obs, resilience
+from crimp_tpu.resilience import faultinject
 
 logger = logging.getLogger(__name__)
 
@@ -131,8 +132,15 @@ def cache_key(kernel: str, poly: bool, n_events: int, n_trials: int,
 def _load_cache(path: pathlib.Path | None = None) -> dict:
     path = cache_path() if path is None else path
     try:
+        faultinject.fire("tuner_cache")
         doc = json.loads(path.read_text())
-    except (OSError, json.JSONDecodeError, ValueError):
+    except OSError:
+        return {}  # missing or unreadable: nothing to quarantine
+    except (json.JSONDecodeError, ValueError, resilience.CacheCorruptError):
+        # A torn or corrupt cache file gets quarantined (atomic rename to
+        # *.corrupt) so the next tune rebuilds it, instead of being
+        # silently reparsed — and refailed — on every resolution.
+        resilience.quarantine_file(path, label="tuner_cache")
         return {}
     if not isinstance(doc, dict) or doc.get("version") != CACHE_VERSION:
         return {}
@@ -221,19 +229,21 @@ def resolve_blocks(kernel: str, n_events: int, n_trials: int,
     elif mode != "off":
         try:
             resolved = cached_blocks(kernel, poly, n_events, n_trials)
-        except Exception:  # noqa: BLE001 — a corrupt cache or an
+        except Exception as exc:  # noqa: BLE001 — a corrupt cache or an
             # uninitializable backend must never take down a search call
-            logger.warning("autotune cache lookup failed; using static "
-                           "defaults", exc_info=True)
+            logger.warning("autotune cache lookup failed (%s); using static "
+                           "defaults", resilience.classify(exc).value,
+                           exc_info=True)
             resolved = None
         _count_cache(resolved is not None)
         if resolved is None and mode == "eager":
             try:
                 out = tune(kernel, n_events, n_trials, poly=poly)
                 resolved = (out["event_block"], out["trial_block"])
-            except Exception:  # noqa: BLE001
-                logger.warning("eager autotune failed; using static "
-                               "defaults", exc_info=True)
+            except Exception as exc:  # noqa: BLE001
+                logger.warning("eager autotune failed (%s); using static "
+                               "defaults", resilience.classify(exc).value,
+                               exc_info=True)
                 resolved = None
     if resolved is None:
         resolved = static_defaults(kernel)
@@ -313,10 +323,11 @@ def resolve_toafit(n_segments: int, n_events: int) -> dict:
     if (env_w is None or env_b is None) and autotune_mode() != "off":
         try:
             cached = cached_toafit(n_segments, n_events)
-        except Exception:  # noqa: BLE001 — a corrupt cache or an
+        except Exception as exc:  # noqa: BLE001 — a corrupt cache or an
             # uninitializable backend must never take down a ToA fit
-            logger.warning("toafit autotune cache lookup failed; using "
-                           "static defaults", exc_info=True)
+            logger.warning("toafit autotune cache lookup failed (%s); using "
+                           "static defaults", resilience.classify(exc).value,
+                           exc_info=True)
             cached = None
         _count_cache(bool(cached))
         if cached:
@@ -387,10 +398,11 @@ def resolve_grid_mxu(n_events: int, n_trials: int, poly: bool = False) -> dict:
     if autotune_mode() != "off":
         try:
             cached = cached_grid_mxu(poly, n_events, n_trials)
-        except Exception:  # noqa: BLE001 — a corrupt cache or an
+        except Exception as exc:  # noqa: BLE001 — a corrupt cache or an
             # uninitializable backend must never take down a search call
-            logger.warning("grid_mxu autotune cache lookup failed; using "
-                           "static defaults", exc_info=True)
+            logger.warning("grid_mxu autotune cache lookup failed (%s); using "
+                           "static defaults", resilience.classify(exc).value,
+                           exc_info=True)
             cached = None
         _count_cache(bool(cached))
         if cached:
@@ -470,10 +482,11 @@ def resolve_delta_fold(n_events: int) -> dict:
     if autotune_mode() != "off":
         try:
             cached = cached_delta_fold(n_events)
-        except Exception:  # noqa: BLE001 — a corrupt cache or an
+        except Exception as exc:  # noqa: BLE001 — a corrupt cache or an
             # uninitializable backend must never take down a fold call
-            logger.warning("delta_fold autotune cache lookup failed; using "
-                           "static defaults", exc_info=True)
+            logger.warning("delta_fold autotune cache lookup failed (%s); "
+                           "using static defaults",
+                           resilience.classify(exc).value, exc_info=True)
             cached = None
         _count_cache(bool(cached))
         if cached:
@@ -553,10 +566,11 @@ def resolve_multisource(n_sources: int, n_events: int) -> dict:
     if autotune_mode() != "off":
         try:
             cached = cached_multisource(n_sources, n_events)
-        except Exception:  # noqa: BLE001 — a corrupt cache or an
+        except Exception as exc:  # noqa: BLE001 — a corrupt cache or an
             # uninitializable backend must never take down a survey call
-            logger.warning("multisource autotune cache lookup failed; using "
-                           "static defaults", exc_info=True)
+            logger.warning("multisource autotune cache lookup failed (%s); "
+                           "using static defaults",
+                           resilience.classify(exc).value, exc_info=True)
             cached = None
         _count_cache(bool(cached))
         if cached:
@@ -607,6 +621,7 @@ def sweep_candidates(kernel: str = "grid",
                    "trials_per_sec": round(float(rate), 1)}
         except Exception as exc:  # noqa: BLE001 — record and continue
             row = {"event_block": int(eb), "trial_block": int(tb),
+                   "kind": resilience.classify(exc).value,
                    "error": f"{type(exc).__name__}: {str(exc)[:200]}"}
         rows.append(row)
         if on_row is not None:

@@ -54,6 +54,7 @@ from __future__ import annotations
 import hashlib
 import logging
 import pathlib
+import zipfile
 from collections import OrderedDict
 from dataclasses import dataclass
 from functools import partial
@@ -62,8 +63,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from crimp_tpu import knobs, obs
+from crimp_tpu import knobs, obs, resilience
 from crimp_tpu.models import timing
+from crimp_tpu.resilience import faultinject
 from crimp_tpu.models.timing import N_FREQ_TERMS, TimingParams
 
 logger = logging.getLogger(__name__)
@@ -74,7 +76,7 @@ F64_MULT_EPS = 2.0 ** -46
 # columns per glitch: GLPH, GLF0, GLF1, GLF2, GLF0D
 N_GLITCH_AMP = 5
 
-CACHE_VERSION = 1
+CACHE_VERSION = 2  # v2: sha256 payload footer detects torn/corrupt writes
 _MEM_CAP = 8
 
 
@@ -402,20 +404,45 @@ def _mem_put(key: str, prod: FoldProduct) -> None:
         _MEM_CACHE.popitem(last=False)
 
 
+def _product_sha(prod: FoldProduct) -> str:
+    """sha256 over the payload arrays; the npz footer that detects a torn
+    or bit-flipped product on load (satellite of the PR-9 quarantine)."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(
+        np.asarray(prod.phases, dtype=np.float64)).tobytes())
+    h.update(np.ascontiguousarray(
+        np.asarray(prod.t_ref, dtype=np.float64)).tobytes())
+    h.update(np.asarray(prod.sizes, dtype=np.int64).tobytes())
+    h.update(np.ascontiguousarray(
+        np.asarray(prod.pvec, dtype=np.float64)).tobytes())
+    h.update(prod.nonlin.encode())
+    return h.hexdigest()
+
+
 def _disk_get(key: str, disk_dir: pathlib.Path) -> FoldProduct | None:
     path = disk_dir / f"{key}.npz"
+    if not path.exists():
+        return None  # plain miss: nothing to verify or quarantine
     try:
+        faultinject.fire("fold_cache")
         with np.load(path, allow_pickle=False) as doc:
             if int(doc["version"]) != CACHE_VERSION:
-                return None
-            return FoldProduct(
+                return None  # older schema, not corruption: version-miss
+            prod = FoldProduct(
                 phases=np.asarray(doc["phases"], dtype=np.float64),
                 t_ref=np.asarray(doc["t_ref"], dtype=np.float64),
                 sizes=tuple(int(s) for s in doc["sizes"]),
                 pvec=np.asarray(doc["pvec"], dtype=np.float64),
                 nonlin=str(doc["nonlin"]),
             )
-    except (OSError, KeyError, ValueError):
+            if str(doc["sha"]) != _product_sha(prod):
+                raise resilience.CacheCorruptError(
+                    f"fold cache {path.name}: sha footer mismatch")
+            return prod
+    except (OSError, KeyError, ValueError, EOFError, zipfile.BadZipFile,
+            resilience.CacheCorruptError):
+        # Torn write or bit rot: quarantine to *.corrupt and refold exact.
+        resilience.quarantine_file(path, label="fold_cache")
         return None
 
 
@@ -427,7 +454,8 @@ def _disk_put(key: str, prod: FoldProduct, disk_dir: pathlib.Path) -> None:
         with open(tmp, "wb") as fh:  # np.savez(path) would append .npz
             np.savez(fh, version=CACHE_VERSION, phases=prod.phases,
                      t_ref=prod.t_ref, sizes=np.asarray(prod.sizes),
-                     pvec=prod.pvec, nonlin=np.str_(prod.nonlin))
+                     pvec=prod.pvec, nonlin=np.str_(prod.nonlin),
+                     sha=np.str_(_product_sha(prod)))
         tmp.rename(path)
     except OSError as exc:
         logger.warning("fold cache write failed (%s); continuing", exc)
@@ -461,11 +489,18 @@ def cached_fold(tm, times_cat, sizes, t_ref, delta, anchor_idx, exact_fn,
     if mode != "off":
         key = fold_key(times_cat, sizes, t_ref, model_sha=nonlin, tag=tag)
         info["key"] = key[:16]
-        prod = _mem_get(key)
-        if prod is None and mode == "disk":
-            prod = _disk_get(key, disk_dir)
-            if prod is not None:
-                _mem_put(key, prod)
+        try:
+            prod = _mem_get(key)
+            if prod is None and mode == "disk":
+                prod = _disk_get(key, disk_dir)
+                if prod is not None:
+                    _mem_put(key, prod)
+        except Exception as exc:  # noqa: BLE001 — fold ladder: any failure
+            # on the cache path drops one rung, to the exact re-anchor fold
+            kind = resilience.classify(exc)
+            resilience.record_degradation("fold", "exact_refold", kind)
+            info["fallback"] = kind.value
+            prod = None
     if prod is not None and prod.nonlin == nonlin and \
             prod.pvec.shape == pvec.shape:
         dp = pvec - prod.pvec
@@ -478,16 +513,24 @@ def cached_fold(tm, times_cat, sizes, t_ref, delta, anchor_idx, exact_fn,
         bound = error_bound_cycles(basis.colmax, dp)
         info["bound_cycles"] = bound
         if bound <= budget:
-            if prod.phases_dev is None:
-                prod.phases_dev = jnp.asarray(prod.phases)
-            folded = np.asarray(refold(prod.phases_dev, basis.b,
-                                       jnp.asarray(dp)))
-            info["mode"] = "delta"
-            obs.counter_add("delta_fold_refolds")
-            _last_info = info
-            return folded, info
-        info["fallback"] = "budget"
-        obs.counter_add("delta_fold_guard_trips")
+            try:
+                if prod.phases_dev is None:
+                    prod.phases_dev = jnp.asarray(prod.phases)
+                folded = np.asarray(refold(prod.phases_dev, basis.b,
+                                           jnp.asarray(dp)))
+                info["mode"] = "delta"
+                obs.counter_add("delta_fold_refolds")
+                _last_info = info
+                return folded, info
+            except Exception as exc:  # noqa: BLE001 — fold ladder: a refold
+                # that dies (device OOM, nonfinite output) degrades to exact
+                kind = resilience.classify(exc)
+                resilience.record_degradation("fold", "exact_refold", kind)
+                info["fallback"] = kind.value
+                obs.counter_add("delta_fold_refold_failures")
+        else:
+            info["fallback"] = "budget"
+            obs.counter_add("delta_fold_guard_trips")
     elif prod is not None:
         info["fallback"] = "nonlinear"
         obs.counter_add("delta_fold_nonlinear_fallbacks")

@@ -45,6 +45,7 @@ import numpy as np
 
 from crimp_tpu import obs
 from crimp_tpu.models import timing
+from crimp_tpu.resilience import faultinject
 from crimp_tpu.ops import anchored, search, toafit
 from crimp_tpu.ops.anchored import AnchoredModel
 from crimp_tpu.utils.logging import get_logger
@@ -267,6 +268,7 @@ def fold_sources(timing_models, seg_times_list, t_ref_list=None):
     chunk = _resolve_chunk(B, E_max)
     folded_rows: list[np.ndarray] = []
     for lo in range(0, B, chunk):
+        faultinject.fire("fold_sources")
         part = prepped[lo:lo + chunk]
         sm = stack_models([p[0] for p in part])
         delta_pad = np.zeros((len(part), E_max))

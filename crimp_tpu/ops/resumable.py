@@ -35,7 +35,8 @@ import pathlib
 
 import numpy as np
 
-from crimp_tpu import obs
+from crimp_tpu import obs, resilience
+from crimp_tpu.resilience import faultinject
 
 CHUNK_TRIALS = 50_000
 
@@ -312,6 +313,31 @@ class ResumableScan:
         threshold = search.stream_min_events()
         return threshold is not None and len(self.times) >= threshold
 
+    def _load_chunk(self, i: int) -> np.ndarray | None:
+        """A checkpointed chunk's rows, validated — or None after
+        quarantining a torn one.
+
+        A resumed store is an unaudited input: a truncated or bit-rotted
+        chunk file must be recomputed, not concatenated into the power
+        grid or allowed to crash the whole resume. Shape is fully
+        determined by the scan geometry, so validation is exact:
+        (n_rows, chunk width), floating dtype."""
+        path = self._chunk_path(i)
+        lo = i * self.chunk_trials
+        width = min(self.chunk_trials, len(self.freqs) - lo)
+        n_rows = 1 if self.statistic == "h" else len(self.fdots)
+        try:
+            faultinject.fire("scan_chunk")
+            arr = np.load(path, allow_pickle=False)
+        except (OSError, ValueError, EOFError, resilience.CacheCorruptError):
+            resilience.quarantine_file(path, label="scan_chunk")
+            return None
+        if arr.ndim != 2 or arr.shape != (n_rows, width) \
+                or not np.issubdtype(arr.dtype, np.floating):
+            resilience.quarantine_file(path, label="scan_chunk")
+            return None
+        return arr
+
     def _compute_chunk_device(self, i: int):
         """(n_fdot, k) Z^2 (or (1, k) H) rows for trial chunk i, still on
         device (materialized by _compute_chunk / the pipelined run loop).
@@ -326,6 +352,7 @@ class ResumableScan:
 
         from crimp_tpu.ops import search
 
+        faultinject.fire("scan_chunk")
         lo = i * self.chunk_trials
         chunk = self.freqs[lo:lo + self.chunk_trials]
         poly = self.poly
@@ -441,9 +468,14 @@ class ResumableScan:
             with obs.span("chunk_loop", kind="stage"):
                 for i in range(self.n_chunks):
                     if i in done:
-                        parts[i] = np.load(self._chunk_path(i))
-                        continue
-                    rows_dev = self._compute_chunk_device(i)
+                        arr = self._load_chunk(i)
+                        if arr is not None:
+                            parts[i] = arr
+                            continue
+                        # torn chunk quarantined: fall through and recompute
+                    rows_dev = resilience.retry_call(
+                        lambda i=i: self._compute_chunk_device(i),
+                        point="scan_chunk")
                     if pending is not None:
                         self._finish_chunk(pending[0], pending[1], parts, progress)
                     pending = (i, rows_dev)

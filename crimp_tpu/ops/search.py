@@ -44,8 +44,9 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 
-from crimp_tpu import knobs, obs
+from crimp_tpu import knobs, obs, resilience
 from crimp_tpu.ops import fasttrig
+from crimp_tpu.resilience import faultinject
 
 DEFAULT_EVENT_BLOCK = 1 << 16
 DEFAULT_TRIAL_BLOCK = 256
@@ -396,16 +397,36 @@ def _grid_sums_dispatch(times, f0, df, n_freq, nharm, poly,
                             poly, event_block, trial_block)
     obs.counter_add("grid_trials", n_freq)
     if use_mxu:
-        # one exact-sincos reseed row per `rs` trials of every trial block
-        obs.counter_add("grid_mxu_reseeds", -(-int(n_freq) // max(1, int(rs))))
-        c, s = harmonic_sums_uniform_mxu(
-            jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
-            reseed=rs, mxu_bf16=b16,
-        )
+        try:
+            faultinject.fire("harmonic_sums")
+            # one exact-sincos reseed row per `rs` trials per trial block
+            obs.counter_add("grid_mxu_reseeds",
+                            -(-int(n_freq) // max(1, int(rs))))
+            c, s = harmonic_sums_uniform_mxu(
+                jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
+                reseed=rs, mxu_bf16=b16,
+            )
+            return c, s, n
+        except Exception as exc:  # noqa: BLE001 — grid ladder: a dead MXU
+            # rung drops to the streamed exact-sincos kernel (bit-identical
+            # to in-core exact, and it bounds device memory — the likely
+            # failure cause), then to the in-core exact kernel.
+            kind = resilience.classify(exc)
+            eb, tb = resolve_blocks("grid", n, n_freq, poly, event_block,
+                                    trial_block)
+            try:
+                resilience.record_degradation("grid", "streamed", kind)
+                c, s = _streamed_uniform_sums(times, f0, df, n_freq, nharm,
+                                              eb, tb, poly)
+                return c, s, n
+            except Exception as exc2:  # noqa: BLE001 — last rung: exact
+                resilience.record_degradation("grid", "exact",
+                                              resilience.classify(exc2))
     else:
-        c, s = harmonic_sums_uniform(
-            jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
-        )
+        faultinject.fire("harmonic_sums")
+    c, s = harmonic_sums_uniform(
+        jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
+    )
     return c, s, n
 
 
@@ -1260,6 +1281,7 @@ def h_power_segments_chunked(times, masks, freqs, nharm: int = 5,
     batch cannot reassociate any row's reduction. ``row_block`` None/<=0
     or >= the row count collapses to one call.
     """
+    faultinject.fire("harmonic_sums")
     times = np.asarray(times)
     n_rows = times.shape[0]
     if row_block is None or row_block <= 0 or row_block >= n_rows:

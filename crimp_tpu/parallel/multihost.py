@@ -76,7 +76,7 @@ def topology_mesh(devices=None, event_parallel: int | None = None) -> Mesh:
         grid = mesh_utils.create_device_mesh(
             (event_parallel, n // event_parallel), devices=devices
         )
-    except Exception:
+    except Exception:  # graftlint: disable=GL006 (layout fallback, not a failure path: virtual/CPU devices carry no coords so the enumeration-order mesh is the same contract)
         # virtual/CPU devices carry no coords; order cannot matter there —
         # same contract, enumeration-order layout
         return build_mesh(devices, event_parallel=event_parallel)

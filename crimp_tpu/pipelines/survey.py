@@ -38,8 +38,9 @@ from dataclasses import dataclass, field
 import numpy as np
 import pandas as pd
 
-from crimp_tpu import obs
+from crimp_tpu import obs, resilience
 from crimp_tpu.io import template as template_io
+from crimp_tpu.resilience import faultinject
 from crimp_tpu.models import profiles, timing
 from crimp_tpu.ops import anchored, multisource, search, toafit
 from crimp_tpu.ops.ephem import spin_frequency_host
@@ -245,7 +246,10 @@ def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
     global _last_info
     n_total = len(specs)
     frames: list[pd.DataFrame | None] = [None] * n_total
-    errors: dict[str, str] = {}
+    # per-source failure records: {"kind", "type", "message"} (classified
+    # by resilience.taxonomy, so chaos tests and operators can tell a data
+    # error from resource exhaustion)
+    errors: dict[str, dict] = {}
     demoted: dict[str, str] = {}
     preps: dict[int, _Prepped] = {}
     fallback: list[int] = []
@@ -254,7 +258,8 @@ def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
         try:
             preps[i] = _prep_source(spec, phShiftRes, nbrBins, varyAmps)
         except Exception as exc:  # noqa: BLE001 — per-source failure domain
-            demoted[spec.name] = f"prep: {type(exc).__name__}: {exc}"
+            demoted[spec.name] = (f"prep: {resilience.classify(exc).value}: "
+                                  f"{type(exc).__name__}: {exc}")
             fallback.append(i)
 
     from crimp_tpu.ops import autotune
@@ -287,11 +292,15 @@ def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
 
     done = 0
     occ_used = occ_total = 0
+    splits = 0
     obs.beat(0, n_total, label="sources", force=True)
-    for bucket in buckets:
+    queue = list(buckets)
+    while queue:
+        bucket = queue.pop(0)
         ps = [preps[i] for i in bucket]
         kind, cfg = ps[0].kind, ps[0].cfg
         try:
+            faultinject.fire("survey_bucket")
             phase_lists, t_refs = multisource.fold_sources(
                 [p.tm for p in ps], [p.seg_times for p in ps]
             )
@@ -314,12 +323,29 @@ def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
                     if p.seg_times else _empty_frame()
                 occ_used += sum(t.size for t in p.seg_times)
                 occ_total += width * len(p.seg_times)
-        except Exception as exc:  # noqa: BLE001 — a bucket-level failure
-            # demotes its sources to the per-source path, never the survey
-            logger.warning("survey bucket failed; falling back per source",
-                           exc_info=True)
+        except Exception as exc:  # noqa: BLE001 — the bucket failure
+            # domain walks the multisource ladder: split the batch in two
+            # and retry (an OOM'd bucket usually fits as two halves), and
+            # only a single-source bucket demotes to the per-source path —
+            # one failure no longer serializes a whole batch
+            fkind = resilience.classify(exc)
+            if len(bucket) > 1:
+                mid = (len(bucket) + 1) // 2
+                queue.insert(0, bucket[mid:])
+                queue.insert(0, bucket[:mid])
+                splits += 1
+                resilience.record_degradation("multisource", "split_bucket",
+                                              fkind)
+                logger.warning(
+                    "survey bucket of %d failed (%s); splitting and "
+                    "retrying", len(bucket), fkind.value, exc_info=True)
+                continue  # halves re-enter the queue; done is unchanged
+            resilience.record_degradation("multisource", "per_source", fkind)
+            logger.warning("survey bucket failed (%s); falling back per "
+                           "source", fkind.value, exc_info=True)
             for i in bucket:
-                demoted[specs[i].name] = f"bucket: {type(exc).__name__}: {exc}"
+                demoted[specs[i].name] = (f"bucket: {fkind.value}: "
+                                          f"{type(exc).__name__}: {exc}")
             fallback.extend(bucket)
         done += len(bucket)
         obs.beat(done, n_total, label="sources")
@@ -331,8 +357,22 @@ def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
                 specs[i], phShiftRes, nbrBins, varyAmps,
                 _prep=preps.get(i),
             )
-        except Exception as exc:  # noqa: BLE001
-            errors[specs[i].name] = f"{type(exc).__name__}: {exc}"
+        except Exception as exc:  # noqa: BLE001 — per-source domain: the
+            # classified record tells operators a data error from resource
+            # exhaustion; device-shaped kinds get one pinned-CPU attempt
+            # (the device ladder's last rung; the run is stamped degraded)
+            fkind = resilience.classify(exc)
+            if fkind in resilience.CPU_FALLBACK_KINDS:
+                try:
+                    with resilience.pinned_cpu(fkind):
+                        frames[i] = measure_source_toas(
+                            specs[i], phShiftRes, nbrBins, varyAmps,
+                            _prep=preps.get(i),
+                        )
+                except Exception as exc2:  # noqa: BLE001 — final: record
+                    errors[specs[i].name] = resilience.error_record(exc2)
+            else:
+                errors[specs[i].name] = resilience.error_record(exc)
         done = min(done + 1, n_total)
         obs.beat(done, n_total, label="sources")
     obs.beat(n_total, n_total, label="sources", force=True)
@@ -345,6 +385,7 @@ def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
         "n_fallback": len(fallback),
         "n_failed": sum(1 for f in frames if f is None),
         "bucket_count": len(buckets),
+        "bucket_splits": splits,
         "occupancy_pct": round(occupancy, 2),
         "demoted": demoted,
         "errors": errors,

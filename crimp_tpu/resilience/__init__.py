@@ -1,0 +1,35 @@
+"""Resilience layer: failure taxonomy, retry/degradation policy, faults.
+
+Public surface (see docs/robustness.md):
+
+* ``classify(exc) -> FailureKind`` — the single exception-classification
+  funnel (graftlint GL006 requires bare ``except Exception`` handlers in
+  crimp_tpu/ to route through it or carry a waiver reason).
+* ``retry_call`` / ``RetryPolicy`` — bounded same-mode retries for
+  transient kinds; bit-identical on success.
+* ``record_degradation`` / ``LADDERS`` — stamp the obs run degraded when
+  an engine falls to a lower parity-pinned rung.
+* ``quarantine_file`` — atomic ``*.corrupt`` rename for bad cache files.
+* ``faultinject.fire(point)`` — deterministic chaos injection, armed by
+  ``CRIMP_TPU_FAULTS``, a no-op otherwise.
+"""
+
+from crimp_tpu.resilience import faultinject, policy, taxonomy
+from crimp_tpu.resilience.policy import (CPU_FALLBACK_KINDS, LADDERS,
+                                         RETRYABLE_KINDS, RetryPolicy,
+                                         default_policy, pinned_cpu,
+                                         quarantine_file, record_degradation,
+                                         retry_call)
+from crimp_tpu.resilience.taxonomy import (CacheCorruptError, CrimpError,
+                                           DataError, FailureKind,
+                                           InjectedFault,
+                                           NonfiniteResultError, classify,
+                                           error_record)
+
+__all__ = [
+    "CPU_FALLBACK_KINDS", "CacheCorruptError", "CrimpError", "DataError",
+    "FailureKind", "InjectedFault", "LADDERS", "NonfiniteResultError",
+    "RETRYABLE_KINDS", "RetryPolicy", "classify", "default_policy",
+    "error_record", "faultinject", "pinned_cpu", "policy",
+    "quarantine_file", "record_degradation", "retry_call", "taxonomy",
+]

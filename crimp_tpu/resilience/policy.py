@@ -1,0 +1,179 @@
+"""Retry and degradation policy: how a classified failure is recovered.
+
+Two recovery shapes exist, and they are deliberately different:
+
+* **Retry** (``retry_call``) — re-run the same computation in the same
+  numeric mode.  Correct for transient kinds (RESOURCE_EXHAUSTED, TIMEOUT,
+  DEVICE_LOST, NONFINITE_RESULT, UNKNOWN); a successful retry is
+  bit-identical to a clean run.  Bounded attempts, exponential backoff,
+  deterministic jitter (sha256 of point+attempt — no wall-clock, no RNG).
+
+* **Degradation** (``record_degradation`` + the per-engine ladders in
+  ``LADDERS``) — fall to the next rung of an already-parity-pinned path.
+  The run completes but is stamped ``degraded`` in the obs manifest, and
+  the perf ledger excludes it from the green baseline.
+
+DATA_ERROR is never retried and never degrades: bad input fails the same
+way on every rung, so it propagates (classified) to the failure domain
+that owns it.  CACHE_CORRUPT has its own recovery — quarantine the file
+(``quarantine_file``) and rebuild — which is a *repair*, not a
+degradation: the rebuilt result is bit-identical.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import logging
+import os
+import time
+
+from crimp_tpu import knobs, obs
+from crimp_tpu.resilience import taxonomy
+from crimp_tpu.resilience.taxonomy import FailureKind
+
+logger = logging.getLogger("crimp_tpu.resilience")
+
+DEFAULT_RETRIES = 1
+DEFAULT_BACKOFF_S = 0.05
+
+# Kinds eligible for same-mode retry.  DATA_ERROR and CACHE_CORRUPT are
+# excluded: they have dedicated recovery domains (see module docstring).
+RETRYABLE_KINDS = frozenset({
+    FailureKind.RESOURCE_EXHAUSTED,
+    FailureKind.TIMEOUT,
+    FailureKind.DEVICE_LOST,
+    FailureKind.NONFINITE_RESULT,
+    FailureKind.UNKNOWN,
+})
+
+# Kinds for which dropping to the pinned-CPU device rung makes sense.
+CPU_FALLBACK_KINDS = frozenset({
+    FailureKind.RESOURCE_EXHAUSTED,
+    FailureKind.DEVICE_LOST,
+})
+
+# Documented ladders: rung order per engine, first rung is the normal
+# path.  Each downward step is a path that already exists and is already
+# parity-pinned by the test suite.  Keep in sync with docs/robustness.md.
+LADDERS = {
+    "multisource": ("batched", "split_bucket", "per_source"),
+    "grid": ("grid_mxu", "streamed", "exact"),
+    "fold": ("delta_fold", "exact_refold"),
+    "device": ("accelerator", "cpu_pinned"),
+}
+
+
+class RetryPolicy:
+    """Bounded same-mode retry: attempts, backoff, per-kind eligibility."""
+
+    __slots__ = ("retries", "backoff_s", "kinds")
+
+    def __init__(self, retries: int = DEFAULT_RETRIES,
+                 backoff_s: float = DEFAULT_BACKOFF_S,
+                 kinds: frozenset = RETRYABLE_KINDS):
+        self.retries = max(int(retries), 0)
+        self.backoff_s = max(float(backoff_s), 0.0)
+        self.kinds = frozenset(kinds)
+
+    def delay_s(self, attempt: int, point: str) -> float:
+        """Exponential backoff with deterministic jitter in [0.5x, 1.0x]."""
+        base = self.backoff_s * (2 ** attempt)
+        digest = hashlib.sha256(f"{point}|{attempt}".encode()).digest()
+        frac = int.from_bytes(digest[:4], "big") / 0xFFFFFFFF
+        return base * (0.5 + 0.5 * frac)
+
+
+def default_policy() -> RetryPolicy:
+    """Policy from knobs: CRIMP_TPU_RETRIES / CRIMP_TPU_BACKOFF_S."""
+    retries = knobs.env_nonneg_int("CRIMP_TPU_RETRIES")
+    if retries is None:
+        retries = DEFAULT_RETRIES
+    return RetryPolicy(
+        retries=retries,
+        backoff_s=knobs.env_float("CRIMP_TPU_BACKOFF_S", DEFAULT_BACKOFF_S),
+    )
+
+
+def retry_call(fn, *, point: str, policy: RetryPolicy | None = None):
+    """Call ``fn()``; retry retryable kinds up to ``policy.retries`` times.
+
+    A successful retry is bit-identical to a clean first attempt (same
+    numeric mode, same inputs).  Non-retryable kinds and exhausted budgets
+    re-raise the original exception, already classified by the caller's
+    failure domain.
+    """
+    if policy is None:
+        policy = default_policy()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as exc:
+            kind = taxonomy.classify(exc)
+            if kind not in policy.kinds or attempt >= policy.retries:
+                raise
+            obs.counter_add("retries", 1)
+            obs.counter_add(f"retries_{point}", 1)
+            logger.warning(
+                "retrying %s after %s (%s; attempt %d of %d)",
+                point, kind.value, type(exc).__name__,
+                attempt + 1, policy.retries)
+            delay = policy.delay_s(attempt, point)
+            if delay > 0:
+                time.sleep(delay)
+            attempt += 1
+
+
+def record_degradation(engine: str, rung: str,
+                       kind: FailureKind | None = None) -> None:
+    """Stamp the active run degraded and count the ladder step taken."""
+    if engine in LADDERS and rung not in LADDERS[engine]:
+        raise ValueError(f"unknown rung {rung!r} for engine {engine!r}")
+    obs.counter_add("degradations", 1)
+    obs.counter_add(f"degraded_{engine}_{rung}", 1)
+    reason = f"{engine}:{rung}" + (f":{kind.value}" if kind else "")
+    obs.mark_degraded(reason)
+    logger.warning("degraded %s -> %s (%s)", engine, rung,
+                   kind.value if kind else "unclassified")
+
+
+def quarantine_file(path, label: str = "cache") -> str | None:
+    """Atomically rename a corrupt cache product to ``*.corrupt``.
+
+    Returns the quarantine path, or None if the file vanished underneath
+    us (lost a race — nothing to do).  Never raises: quarantine is
+    best-effort repair bookkeeping and must not mask the rebuild.
+    """
+    src = os.fspath(path)
+    target = src + ".corrupt"
+    try:
+        os.replace(src, target)
+    except OSError:
+        return None
+    obs.counter_add("quarantined_files", 1)
+    obs.counter_add(f"quarantined_{label}", 1)
+    logger.warning("quarantined corrupt %s file %s -> %s; rebuilding",
+                   label, src, target)
+    return target
+
+
+@contextlib.contextmanager
+def pinned_cpu(kind: FailureKind | None = None):
+    """Last ladder rung: re-dispatch under the pinned CPU device.
+
+    Imports jax lazily so the resilience package stays importable on
+    hosts without an accelerator runtime.
+    """
+    import jax
+
+    record_degradation("device", "cpu_pinned", kind)
+    with jax.default_device(jax.devices("cpu")[0]):
+        yield
+
+
+__all__ = [
+    "CPU_FALLBACK_KINDS", "DEFAULT_BACKOFF_S", "DEFAULT_RETRIES", "LADDERS",
+    "RETRYABLE_KINDS", "RetryPolicy", "default_policy", "pinned_cpu",
+    "quarantine_file", "record_degradation", "retry_call",
+]

@@ -1,0 +1,165 @@
+"""Failure taxonomy: map raw exceptions to a closed set of FailureKinds.
+
+Everything downstream of a failure — retry eligibility, degradation
+ladders, quarantine, the ``last_survey_info()`` error records — keys off
+the *kind* of a failure, never off the exception class or message text.
+``classify()`` is the single funnel: it pattern-matches
+XlaRuntimeError/jaxlib message fragments (those exceptions cannot be
+imported without dragging jax in, and their concrete class moved between
+jaxlib releases), recognises our own typed errors by their ``kind``
+attribute, and falls back to builtin-exception heuristics.
+
+This module must stay importable without jax (it is pulled in by host-side
+cache code and by graftlint fixtures).
+"""
+
+from __future__ import annotations
+
+import enum
+import errno
+import json
+import zipfile
+
+
+class FailureKind(enum.Enum):
+    """Closed classification of runtime failures (see docs/robustness.md)."""
+
+    RESOURCE_EXHAUSTED = "resource_exhausted"
+    DEVICE_LOST = "device_lost"
+    NONFINITE_RESULT = "nonfinite_result"
+    CACHE_CORRUPT = "cache_corrupt"
+    TIMEOUT = "timeout"
+    DATA_ERROR = "data_error"
+    UNKNOWN = "unknown"
+
+
+class CrimpError(Exception):
+    """Base for crimp_tpu typed errors; subclasses pin a FailureKind."""
+
+    kind: FailureKind = FailureKind.UNKNOWN
+
+
+class NonfiniteResultError(CrimpError):
+    """A kernel produced NaN/Inf where the contract requires finite output."""
+
+    kind = FailureKind.NONFINITE_RESULT
+
+
+class CacheCorruptError(CrimpError):
+    """An on-disk cache product failed validation (torn write, bad sha)."""
+
+    kind = FailureKind.CACHE_CORRUPT
+
+
+class DataError(CrimpError):
+    """Caller-supplied data violated an invariant (empty source, bad shape)."""
+
+    kind = FailureKind.DATA_ERROR
+
+
+class InjectedFault(CrimpError):
+    """Raised by the fault injector; carries the kind it is impersonating."""
+
+    def __init__(self, kind: FailureKind, point: str, call_no: int):
+        super().__init__(
+            f"injected {kind.value} fault at point '{point}' (call #{call_no})")
+        self.kind = kind
+        self.point = point
+
+
+# Message fragments that identify accelerator-runtime failures.  These come
+# from XlaRuntimeError / jaxlib exceptions whose class identity is unstable
+# across releases, so we match on text (lowercased) instead of type.
+_RESOURCE_PATTERNS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "out-of-memory",
+    "oom",
+    "failed to allocate",
+    "allocation failure",
+    "hbm",
+)
+_TIMEOUT_PATTERNS = (
+    "deadline_exceeded",
+    "deadline exceeded",
+    "timed out",
+    "timeout",
+)
+_DEVICE_PATTERNS = (
+    "device_lost",
+    "device lost",
+    "device or resource busy",
+    "device halted",
+    "tpu driver",
+    "device unavailable",
+    "failed_precondition: device",
+)
+_NONFINITE_PATTERNS = (
+    "nan",
+    "non-finite",
+    "nonfinite",
+    "not finite",
+)
+
+
+def _match(text: str, patterns: tuple[str, ...]) -> bool:
+    return any(p in text for p in patterns)
+
+
+def classify(exc: BaseException) -> FailureKind:
+    """Map an exception to its FailureKind.
+
+    Order matters: typed errors carry their own kind; accelerator-runtime
+    errors are recognised by type *name* + message fragments; builtins come
+    last so an XlaRuntimeError wrapping a ValueError-ish message is not
+    misfiled as DATA_ERROR.
+    """
+    kind = getattr(exc, "kind", None)
+    if isinstance(kind, FailureKind):
+        return kind
+
+    text = str(exc).lower()
+    type_name = type(exc).__name__
+    # Accelerator runtime errors: XlaRuntimeError and friends out of jaxlib.
+    module = type(exc).__module__ or ""
+    from_runtime = ("jaxlib" in module or "jax" in module
+                    or "XlaRuntimeError" in type_name)
+    if from_runtime or _match(text, _RESOURCE_PATTERNS + _TIMEOUT_PATTERNS
+                              + _DEVICE_PATTERNS):
+        if _match(text, _RESOURCE_PATTERNS):
+            return FailureKind.RESOURCE_EXHAUSTED
+        if _match(text, _DEVICE_PATTERNS):
+            return FailureKind.DEVICE_LOST
+        if _match(text, _TIMEOUT_PATTERNS):
+            return FailureKind.TIMEOUT
+        if from_runtime and _match(text, _NONFINITE_PATTERNS):
+            return FailureKind.NONFINITE_RESULT
+
+    if isinstance(exc, MemoryError):
+        return FailureKind.RESOURCE_EXHAUSTED
+    if isinstance(exc, TimeoutError):
+        return FailureKind.TIMEOUT
+    if isinstance(exc, FloatingPointError):
+        return FailureKind.NONFINITE_RESULT
+    # JSONDecodeError subclasses ValueError: check cache-corruption shapes
+    # before the generic data-error bucket.
+    if isinstance(exc, (json.JSONDecodeError, zipfile.BadZipFile, EOFError)):
+        return FailureKind.CACHE_CORRUPT
+    if isinstance(exc, OSError):
+        if exc.errno in (errno.ENOSPC, errno.EDQUOT):
+            return FailureKind.RESOURCE_EXHAUSTED
+        return FailureKind.DATA_ERROR
+    if isinstance(exc, (ValueError, KeyError, TypeError, IndexError,
+                        AssertionError)):
+        return FailureKind.DATA_ERROR
+    return FailureKind.UNKNOWN
+
+
+def error_record(exc: BaseException) -> dict:
+    """Uniform error record for info dicts: kind + class + message."""
+    return {
+        "kind": classify(exc).value,
+        "type": type(exc).__name__,
+        "message": str(exc),
+    }

@@ -115,7 +115,7 @@ def install_compile_listeners() -> bool:
     try:
         monitoring.register_event_listener(_on_event)
         monitoring.register_event_duration_secs_listener(_on_duration)
-    except Exception:  # noqa: BLE001 — telemetry must never break import
+    except Exception:  # noqa: BLE001 — telemetry must never break import  # graftlint: disable=GL006 (telemetry guard: jax.monitoring listeners are optional; failing to install them must not break import)
         return False
     _LISTENERS_INSTALLED = True
     return True

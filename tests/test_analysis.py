@@ -30,7 +30,8 @@ REPO = pathlib.Path(__file__).resolve().parents[1]
 def run_tree(tmp_path, files, *, rules=None, registry=None,
              tools_md_text="", numeric_keys=("fake_mode",),
              gl004_allowlist=("pkg/anchor.py",),
-             gl005_modules=("pkg/parallel/",)):
+             gl005_modules=("pkg/parallel/",),
+             gl006_modules=("pkg/",)):
     """Write a fixture tree and run the analyzer over it."""
     for rel, text in files.items():
         p = tmp_path / rel
@@ -50,6 +51,7 @@ def run_tree(tmp_path, files, *, rules=None, registry=None,
         resumable_py=resumable,
         gl004_allowlist=gl004_allowlist,
         gl005_modules=gl005_modules,
+        gl006_modules=gl006_modules,
     )
     return engine.run(cfg)
 
@@ -485,6 +487,106 @@ class TestGL005:
             def combine(a):
                 return jnp.sum(a, axis=0)  # graftlint: disable=GL005 (fixture: replicated axis, fixed per-shard order)
         """}, rules=("GL005",))
+        assert rep.unwaived == []
+
+
+# ---------------------------------------------------------------------------
+# GL006 failure-domain discipline
+# ---------------------------------------------------------------------------
+
+
+class TestGL006:
+    def test_bare_except_exception_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            def f():
+                try:
+                    risky()
+                except Exception as exc:
+                    return None
+        """}, rules=("GL006",))
+        assert len(rep.unwaived) == 1
+        assert rep.unwaived[0].rule == "GL006"
+
+    def test_bare_except_colon_and_tuple_fire(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            def f():
+                try:
+                    risky()
+                except:
+                    pass
+
+            def g():
+                try:
+                    risky()
+                except (ValueError, Exception):
+                    pass
+        """}, rules=("GL006",))
+        assert len(rep.unwaived) == 2
+
+    def test_narrow_except_is_clean(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            def f():
+                try:
+                    risky()
+                except (ValueError, OSError):
+                    return None
+        """}, rules=("GL006",))
+        assert rep.unwaived == []
+
+    def test_classify_call_satisfies(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            from pkg import resilience
+
+            def f():
+                try:
+                    risky()
+                except Exception as exc:
+                    kind = resilience.classify(exc)
+                    return kind
+        """}, rules=("GL006",))
+        assert rep.unwaived == []
+
+    def test_error_record_call_satisfies(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            from pkg.resilience import error_record
+
+            def f():
+                try:
+                    risky()
+                except Exception as exc:
+                    return error_record(exc)
+        """}, rules=("GL006",))
+        assert rep.unwaived == []
+
+    def test_bare_reraise_satisfies(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            def f():
+                try:
+                    risky()
+                except Exception:
+                    cleanup()
+                    raise
+        """}, rules=("GL006",))
+        assert rep.unwaived == []
+
+    def test_outside_scoped_modules_is_clean(self, tmp_path):
+        rep = run_tree(tmp_path, {"scripts/tool.py": """
+            def f():
+                try:
+                    risky()
+                except Exception:
+                    pass
+        """}, rules=("GL006",))
+        assert rep.unwaived == []
+
+    def test_waived_with_reason(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            def f():
+                try:
+                    risky()
+                except Exception:  # graftlint: disable=GL006 (fixture: telemetry guard, deliberate swallow domain)
+                    pass
+        """}, rules=("GL006",))
         assert rep.unwaived == []
 
 

@@ -1,0 +1,627 @@
+"""Resilience layer: taxonomy, retry/degradation policy, fault injection.
+
+Three contracts are pinned here:
+
+1. **Chaos matrix** — every fault kind injected at every wired point
+   either recovers bit-identically (same-mode retry, cache rebuild) or
+   lands on a documented ladder rung with the degradation counters and
+   manifest stamp to prove it. No fault at a wired point crashes a
+   pipeline that has a rung left.
+2. **Knob-off pin** — with CRIMP_TPU_FAULTS unset the injector is inert
+   (no plan state, no writes) and hot paths are bit-identical run to
+   run; default retry policy matches the registry defaults.
+3. **Quarantine, not swallow** — corrupt cache artifacts (autotune JSON,
+   delta-fold npz, resumable chunk) are renamed ``*.corrupt`` and
+   rebuilt, never silently reparsed or concatenated.
+"""
+
+import errno
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from crimp_tpu import obs  # noqa: E402
+from crimp_tpu.obs import core as obs_core  # noqa: E402
+from crimp_tpu.obs import ledger  # noqa: E402
+from crimp_tpu.obs.manifest import load_manifest  # noqa: E402
+from crimp_tpu.ops import anchored, autotune, deltafold, multisource, search  # noqa: E402
+from crimp_tpu.ops.resumable import ResumableScan  # noqa: E402
+from crimp_tpu.pipelines import survey  # noqa: E402
+from crimp_tpu.resilience import faultinject, policy, taxonomy  # noqa: E402
+from crimp_tpu.resilience.taxonomy import FailureKind  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    """No stray resilience knobs, a disarmed injector, empty fold cache."""
+    for var in ("CRIMP_TPU_FAULTS", "CRIMP_TPU_RETRIES",
+                "CRIMP_TPU_BACKOFF_S", "CRIMP_TPU_FOLD_CACHE",
+                "CRIMP_TPU_DELTA_FOLD"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("CRIMP_TPU_AUTOTUNE", "0")
+    faultinject.reset()
+    deltafold.clear_cache()
+    yield
+    faultinject.reset()
+    deltafold.clear_cache()
+
+
+@pytest.fixture()
+def obs_on(monkeypatch, tmp_path):
+    out = tmp_path / "obs"
+    monkeypatch.setenv("CRIMP_TPU_OBS", "1")
+    monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(out))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+
+class _FakeXlaRuntimeError(Exception):
+    pass
+
+
+# classify() matches runtime errors on type NAME, not identity
+_FakeXlaRuntimeError.__name__ = "XlaRuntimeError"
+
+
+class TestTaxonomy:
+    @pytest.mark.parametrize("exc,kind", [
+        (MemoryError("boom"), FailureKind.RESOURCE_EXHAUSTED),
+        (TimeoutError("slow"), FailureKind.TIMEOUT),
+        (FloatingPointError("nan"), FailureKind.NONFINITE_RESULT),
+        (ValueError("bad shape"), FailureKind.DATA_ERROR),
+        (KeyError("F0"), FailureKind.DATA_ERROR),
+        (EOFError("truncated"), FailureKind.CACHE_CORRUPT),
+        (OSError(errno.ENOSPC, "no space"), FailureKind.RESOURCE_EXHAUSTED),
+        (OSError(errno.EACCES, "denied"), FailureKind.DATA_ERROR),
+        (RuntimeError("mystery"), FailureKind.UNKNOWN),
+        (taxonomy.NonfiniteResultError("x"), FailureKind.NONFINITE_RESULT),
+        (taxonomy.CacheCorruptError("x"), FailureKind.CACHE_CORRUPT),
+        (taxonomy.DataError("x"), FailureKind.DATA_ERROR),
+    ])
+    def test_builtin_and_typed_mapping(self, exc, kind):
+        assert taxonomy.classify(exc) is kind
+
+    def test_json_decode_error_is_cache_corrupt_not_data(self):
+        try:
+            json.loads("{broken")
+        except json.JSONDecodeError as exc:
+            assert taxonomy.classify(exc) is FailureKind.CACHE_CORRUPT
+
+    @pytest.mark.parametrize("msg,kind", [
+        ("RESOURCE_EXHAUSTED: Out of memory allocating 2.1G on TPU_0",
+         FailureKind.RESOURCE_EXHAUSTED),
+        ("DEADLINE_EXCEEDED: collective timed out", FailureKind.TIMEOUT),
+        ("device halted unexpectedly", FailureKind.DEVICE_LOST),
+        ("INTERNAL: generated NaN during all-reduce",
+         FailureKind.NONFINITE_RESULT),
+    ])
+    def test_runtime_error_message_patterns(self, msg, kind):
+        assert taxonomy.classify(_FakeXlaRuntimeError(msg)) is kind
+
+    def test_injected_fault_carries_its_kind(self):
+        exc = taxonomy.InjectedFault(FailureKind.DEVICE_LOST, "p", 3)
+        assert taxonomy.classify(exc) is FailureKind.DEVICE_LOST
+        assert exc.point == "p"
+
+    def test_error_record_shape(self):
+        rec = taxonomy.error_record(ValueError("nope"))
+        assert rec == {"kind": "data_error", "type": "ValueError",
+                       "message": "nope"}
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+# ---------------------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_defaults_match_registry(self):
+        p = policy.default_policy()
+        assert p.retries == policy.DEFAULT_RETRIES == 1
+        assert p.backoff_s == policy.DEFAULT_BACKOFF_S == 0.05
+        assert p.kinds == policy.RETRYABLE_KINDS
+
+    def test_knobs_override(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_RETRIES", "3")
+        monkeypatch.setenv("CRIMP_TPU_BACKOFF_S", "0.5")
+        p = policy.default_policy()
+        assert p.retries == 3 and p.backoff_s == 0.5
+
+    def test_jitter_is_deterministic_and_point_dependent(self):
+        p = policy.RetryPolicy(backoff_s=0.1)
+        assert p.delay_s(0, "a") == p.delay_s(0, "a")
+        assert p.delay_s(0, "a") != p.delay_s(0, "b")
+        assert p.delay_s(1, "a") > p.delay_s(0, "a")  # exponential
+        assert 0.05 <= p.delay_s(0, "a") <= 0.1  # jitter in [0.5x, 1.0x]
+
+    def test_transient_kind_retried_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise MemoryError("transient")
+            return 42
+
+        p = policy.RetryPolicy(retries=1, backoff_s=0.0)
+        assert policy.retry_call(flaky, point="t", policy=p) == 42
+        assert len(calls) == 2
+
+    def test_data_error_never_retried(self):
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("bad input")
+
+        p = policy.RetryPolicy(retries=5, backoff_s=0.0)
+        with pytest.raises(ValueError):
+            policy.retry_call(bad, point="t", policy=p)
+        assert len(calls) == 1
+
+    def test_budget_exhaustion_reraises(self):
+        calls = []
+
+        def always_oom():
+            calls.append(1)
+            raise MemoryError("persistent")
+
+        p = policy.RetryPolicy(retries=2, backoff_s=0.0)
+        with pytest.raises(MemoryError):
+            policy.retry_call(always_oom, point="t", policy=p)
+        assert len(calls) == 3  # 1 + 2 retries
+
+    def test_zero_retries_disables(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_RETRIES", "0")
+        with pytest.raises(MemoryError):
+            policy.retry_call(lambda: (_ for _ in ()).throw(MemoryError()),
+                              point="t")
+
+    def test_unknown_rung_rejected(self):
+        with pytest.raises(ValueError, match="rung"):
+            policy.record_degradation("grid", "warp_drive")
+
+
+# ---------------------------------------------------------------------------
+# fault injector
+# ---------------------------------------------------------------------------
+
+
+class TestFaultInjector:
+    def test_unset_knob_keeps_injector_inert(self):
+        for _ in range(100):
+            faultinject.fire("fold_sources")
+        assert faultinject._PLAN is None  # zero state built, zero writes
+        assert faultinject.plan_snapshot() == {}
+
+    @pytest.mark.parametrize("spec", [
+        "oom:nowhere:1",          # unknown point
+        "zap:fold_cache:1",       # unknown kind
+        "oom:fold_cache:x",       # non-int n
+        "oom:fold_cache:0",       # n < 1
+        "oom:fold_cache",         # missing n
+    ])
+    def test_typos_fail_loudly(self, monkeypatch, spec):
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", spec)
+        with pytest.raises(ValueError):
+            faultinject.fire("fold_cache")
+
+    def test_fires_on_exactly_nth_call_then_disarms(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "oom:scan_chunk:3")
+        faultinject.fire("scan_chunk")
+        faultinject.fire("scan_chunk")
+        with pytest.raises(taxonomy.InjectedFault) as e:
+            faultinject.fire("scan_chunk")
+        assert taxonomy.classify(e.value) is FailureKind.RESOURCE_EXHAUSTED
+        for _ in range(10):
+            faultinject.fire("scan_chunk")  # disarmed: never fires again
+
+    def test_other_points_unaffected(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "nan:fold_cache:1")
+        faultinject.fire("scan_chunk")
+        faultinject.fire("survey_bucket")
+        with pytest.raises(taxonomy.NonfiniteResultError):
+            faultinject.fire("fold_cache")
+
+    def test_corrupt_and_data_raise_plain_typed_errors(self, monkeypatch):
+        # so the REAL quarantine/validation machinery handles them,
+        # indistinguishable from organic failures
+        monkeypatch.setenv("CRIMP_TPU_FAULTS",
+                           "corrupt:fold_cache:1,data:scan_chunk:1")
+        with pytest.raises(taxonomy.CacheCorruptError):
+            faultinject.fire("fold_cache")
+        with pytest.raises(taxonomy.DataError):
+            faultinject.fire("scan_chunk")
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: grid ladder (harmonic_sums)
+# ---------------------------------------------------------------------------
+
+
+def _grid_events(n=3000, seed=7):
+    rng = np.random.RandomState(seed)
+    return np.sort(rng.uniform(0.0, 5000.0, n))
+
+
+class TestGridLadder:
+    @pytest.mark.parametrize("kind", sorted(faultinject.KIND_NAMES))
+    def test_every_kind_drops_mxu_to_streamed_rung(self, monkeypatch,
+                                                   obs_on, kind):
+        times = _grid_events()
+        args = (times, 0.1425, 1e-6, 128, 2)
+        expected = np.asarray(search.z2_power_grid(*args, mxu=False))
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", f"{kind}:harmonic_sums:1")
+        faultinject.reset()
+        with obs.run("grid_chaos"):
+            got = np.asarray(search.z2_power_grid(*args, mxu=True))
+        # streamed rung is exact-sincos: bit-identical to the exact kernel
+        np.testing.assert_array_equal(got, expected)
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["degraded"] is True
+        assert doc["counters"]["degraded_grid_streamed"] == 1
+        want = faultinject.KIND_NAMES[kind].value
+        assert f"grid:streamed:{want}" in doc["degradations"]
+
+    def test_no_fault_no_degradation(self, obs_on):
+        times = _grid_events()
+        with obs.run("grid_clean"):
+            search.z2_power_grid(times, 0.1425, 1e-6, 128, 2, mxu=False)
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["degraded"] is False
+        assert doc["degradations"] == []
+        assert "degradations" not in doc["counters"]
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: delta-fold ladder + npz quarantine (fold_cache)
+# ---------------------------------------------------------------------------
+
+
+FOLD_TM = {
+    "PEPOCH": 58359.55765869704,
+    "F0": 0.14328254547263483, "F1": -9.746993965547238e-15,
+    "GLEP_1": 58400.0, "GLPH_1": 0.01, "GLF0_1": 3e-8,
+}
+
+
+def _fold_segments(n_per=600, n_seg=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return [np.sort(58320.0 + 120.0 * i + rng.uniform(0.0, 100.0, n_per))
+            for i in range(n_seg)]
+
+
+class TestFoldLadder:
+    def _prime(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("CRIMP_TPU_FOLD_CACHE", str(tmp_path / "fc"))
+        segs = _fold_segments()
+        baseline = anchored.fold_segments(FOLD_TM, segs, delta_fold=1)
+        return segs, baseline
+
+    def _refold_from_disk(self, segs):
+        deltafold.clear_cache()  # force the disk-cache path
+        return anchored.fold_segments(FOLD_TM, segs, delta_fold=1)
+
+    @pytest.mark.parametrize("kind", ["oom", "corrupt", "device", "nan"])
+    def test_cache_fault_degrades_to_exact_refold_bitwise(
+            self, monkeypatch, tmp_path, obs_on, kind):
+        segs, baseline = self._prime(monkeypatch, tmp_path)
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", f"{kind}:fold_cache:1")
+        faultinject.reset()
+        with obs.run("fold_chaos"):
+            got = self._refold_from_disk(segs)
+        for a, b in zip(got, baseline):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["degraded"] is True or kind == "corrupt"
+        if kind == "corrupt":
+            # handled by the real quarantine machinery: repair, not rung
+            assert doc["counters"]["quarantined_fold_cache"] == 1
+            assert list((tmp_path / "fc").glob("*.corrupt"))
+        else:
+            assert doc["counters"]["degraded_fold_exact_refold"] == 1
+
+    def test_sha_footer_detects_bit_rot(self, monkeypatch, tmp_path, obs_on):
+        segs, baseline = self._prime(monkeypatch, tmp_path)
+        (npz_path,) = (tmp_path / "fc").glob("*.npz")
+        # flip the payload but keep the stored sha: only the footer check
+        # can catch this (the zip container is still perfectly valid)
+        with np.load(npz_path, allow_pickle=False) as doc:
+            fields = {k: doc[k] for k in doc.files}
+        fields["phases"] = fields["phases"] + 0.25
+        with open(npz_path, "wb") as fh:
+            np.savez(fh, **fields)
+        with obs.run("fold_rot"):
+            got = self._refold_from_disk(segs)
+        for a, b in zip(got, baseline):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["counters"]["quarantined_fold_cache"] == 1
+        assert npz_path.with_name(npz_path.name + ".corrupt").exists()
+        assert npz_path.exists()  # the exact refold re-stored a good copy
+        got2 = self._refold_from_disk(segs)  # second consult: clean hit
+        for a, b in zip(got2, baseline):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_truncated_npz_quarantined(self, monkeypatch, tmp_path):
+        segs, baseline = self._prime(monkeypatch, tmp_path)
+        (npz_path,) = (tmp_path / "fc").glob("*.npz")
+        npz_path.write_bytes(npz_path.read_bytes()[:100])
+        got = self._refold_from_disk(segs)
+        for a, b in zip(got, baseline):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert npz_path.with_name(npz_path.name + ".corrupt").exists()
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: autotune cache quarantine (tuner_cache)
+# ---------------------------------------------------------------------------
+
+
+class TestTunerCacheQuarantine:
+    def test_garbage_json_quarantined_and_defaults_returned(self, tmp_path):
+        path = tmp_path / "autotune.json"
+        path.write_text("{definitely not json")
+        assert autotune._load_cache(path) == {}
+        assert path.with_name(path.name + ".corrupt").exists()
+        assert not path.exists()
+
+    def test_missing_file_is_not_quarantined(self, tmp_path):
+        path = tmp_path / "nope.json"
+        assert autotune._load_cache(path) == {}
+        assert not path.with_name(path.name + ".corrupt").exists()
+
+    def test_injected_corrupt_quarantines_real_file(self, monkeypatch,
+                                                    tmp_path):
+        path = tmp_path / "autotune.json"
+        path.write_text("{}")
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "corrupt:tuner_cache:1")
+        faultinject.reset()
+        assert autotune._load_cache(path) == {}
+        assert path.with_name(path.name + ".corrupt").exists()
+
+    def test_resolver_survives_any_injected_kind(self, monkeypatch):
+        # resolve_blocks consults the cache under its own failure domain:
+        # even a kind _load_cache does not catch must not break resolution
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "unknown:tuner_cache:1")
+        monkeypatch.setenv("CRIMP_TPU_AUTOTUNE", "auto")  # cached, no sweep
+        faultinject.reset()
+        eb, tb = autotune.resolve_blocks("grid", 10_000, 1000, False,
+                                         None, None)
+        assert eb > 0 and tb > 0
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: resumable scan (scan_chunk)
+# ---------------------------------------------------------------------------
+
+
+def _scan_args():
+    rng = np.random.RandomState(11)
+    times = np.sort(rng.uniform(0.0, 86400.0, 2000))
+    freqs = np.linspace(0.1428, 0.1436, 300)
+    return times, freqs
+
+
+class TestScanChunkChaos:
+    @pytest.mark.parametrize("kind", ["oom", "device", "timeout", "nan",
+                                      "unknown"])
+    def test_retryable_kinds_recover_bit_identically(self, monkeypatch,
+                                                     obs_on, kind):
+        times, freqs = _scan_args()
+        expected = ResumableScan(times, freqs, nharm=2, chunk_trials=100).run()
+        monkeypatch.setenv("CRIMP_TPU_BACKOFF_S", "0")
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", f"{kind}:scan_chunk:2")
+        faultinject.reset()
+        with obs.run("scan_chaos"):
+            got = ResumableScan(times, freqs, nharm=2, chunk_trials=100).run()
+        np.testing.assert_array_equal(got, expected)
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["counters"]["retries_scan_chunk"] == 1
+        assert doc["degraded"] is False  # a retry is not a degradation
+
+    def test_data_error_propagates_unretried(self, monkeypatch):
+        times, freqs = _scan_args()
+        monkeypatch.setenv("CRIMP_TPU_BACKOFF_S", "0")
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "data:scan_chunk:1")
+        faultinject.reset()
+        with pytest.raises(taxonomy.DataError):
+            ResumableScan(times, freqs, nharm=2, chunk_trials=100).run()
+
+    def test_torn_chunk_quarantined_and_recomputed(self, tmp_path):
+        times, freqs = _scan_args()
+        store = tmp_path / "scan"
+        expected = ResumableScan(times, freqs, nharm=2, chunk_trials=100,
+                                 store=str(store)).run()
+        chunk = store / "chunk_00001.npy"
+        chunk.write_bytes(chunk.read_bytes()[:40])  # torn write
+        got = ResumableScan(times, freqs, nharm=2, chunk_trials=100,
+                            store=str(store)).run()
+        np.testing.assert_array_equal(got, expected)
+        assert (store / "chunk_00001.npy.corrupt").exists()
+        assert (store / "chunk_00001.npy").exists()  # recomputed + re-stored
+
+    def test_wrong_shape_chunk_quarantined(self, tmp_path):
+        times, freqs = _scan_args()
+        store = tmp_path / "scan"
+        expected = ResumableScan(times, freqs, nharm=2, chunk_trials=100,
+                                 store=str(store)).run()
+        np.save(store / "chunk_00000.npy", np.zeros((3, 7)))
+        got = ResumableScan(times, freqs, nharm=2, chunk_trials=100,
+                            store=str(store)).run()
+        np.testing.assert_array_equal(got, expected)
+        assert (store / "chunk_00000.npy.corrupt").exists()
+
+
+# ---------------------------------------------------------------------------
+# chaos matrix: survey ladder (survey_bucket, fold_sources)
+# ---------------------------------------------------------------------------
+
+
+TPL = {"model": "fourier", "nbrComp": 2, "norm": 1.0,
+       "amp_1": 0.3, "amp_2": 0.1, "ph_1": 0.2, "ph_2": 0.05}
+
+
+def _make_spec(i, rng, n_per=60, n_int=2, name=None):
+    edges = np.linspace(58000.0, 58008.0, n_int + 1)
+    times = np.sort(np.concatenate([
+        rng.uniform(lo + 1e-6, hi - 1e-6, n_per)
+        for lo, hi in zip(edges[:-1], edges[1:])
+    ]))
+    iv = pd.DataFrame({
+        "ToA_tstart": edges[:-1], "ToA_tend": edges[1:],
+        "ToA_exposure": np.full(n_int, (edges[1] - edges[0]) * 86400.0),
+    })
+    tm = {"PEPOCH": 58000.0, "F0": 0.14 + 0.003 * (i % 53), "F1": -1e-13}
+    return survey.SourceSpec(name=name or f"src{i}", times=times,
+                             timing_model=tm, template=dict(TPL),
+                             intervals=iv)
+
+
+def _assert_bitwise(frame, solo, ctx):
+    for col in survey.SURVEY_TOA_COLUMNS:
+        assert np.array_equal(frame[col].to_numpy(), solo[col].to_numpy()), \
+            (ctx, col)
+
+
+class TestSurveyLadder:
+    def test_bucket_oom_splits_and_recovers_bitwise(self, obs_on,
+                                                    monkeypatch):
+        rng = np.random.RandomState(31)
+        specs = [_make_spec(i, rng) for i in range(2)]
+        solos = [survey.measure_source_toas(s, phShiftRes=200)
+                 for s in specs]
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "oom:survey_bucket:1")
+        faultinject.reset()
+        frames = survey.survey_measure_toas(specs, phShiftRes=200)
+        info = survey.last_survey_info()
+        assert info["bucket_splits"] == 1
+        assert info["errors"] == {} and info["demoted"] == {}
+        # equal per-interval counts -> exact padding -> every column
+        # bitwise, whatever bucket composition the split produced
+        for spec, frame, solo in zip(specs, frames, solos):
+            _assert_bitwise(frame, solo, spec.name)
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["degraded"] is True
+        assert doc["counters"]["degraded_multisource_split_bucket"] == 1
+        assert "multisource:split_bucket:resource_exhausted" \
+            in doc["degradations"]
+
+    @pytest.mark.parametrize("point", ["survey_bucket", "fold_sources"])
+    def test_single_source_bucket_demotes_per_source(self, obs_on,
+                                                     monkeypatch, point):
+        rng = np.random.RandomState(32)
+        spec = _make_spec(0, rng)
+        solo = survey.measure_source_toas(spec, phShiftRes=200)
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", f"oom:{point}:1")
+        faultinject.reset()
+        frames = survey.survey_measure_toas([spec], phShiftRes=200)
+        info = survey.last_survey_info()
+        assert info["errors"] == {}
+        assert info["demoted"][spec.name].startswith(
+            "bucket: resource_exhausted: InjectedFault")
+        _assert_bitwise(frames[0], solo, spec.name)
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["counters"]["degraded_multisource_per_source"] == 1
+
+    def test_failed_source_error_is_classified(self):
+        rng = np.random.RandomState(33)
+        bad = _make_spec(0, rng, name="badsrc")
+        bad.times = bad.times[bad.times < 58004.0]  # last interval empty
+        frames = survey.survey_measure_toas([bad, _make_spec(1, rng)],
+                                            phShiftRes=200)
+        info = survey.last_survey_info()
+        assert frames[0] is None and frames[1] is not None
+        rec = info["errors"]["badsrc"]
+        assert set(rec) == {"kind", "type", "message"}
+        assert rec["kind"] in {k.value for k in FailureKind}
+        assert rec["type"]  # exception class name survives
+
+
+# ---------------------------------------------------------------------------
+# knob-off pin: faults unset -> engines bit-identical, injector inert
+# ---------------------------------------------------------------------------
+
+
+class TestKnobOffPins:
+    def test_grid_bit_identical_run_to_run(self):
+        times = _grid_events()
+        a = np.asarray(search.z2_power_grid(times, 0.1425, 1e-6, 128, 2))
+        b = np.asarray(search.z2_power_grid(times, 0.1425, 1e-6, 128, 2))
+        np.testing.assert_array_equal(a, b)
+        assert faultinject._PLAN is None  # hot path never built a plan
+
+    def test_survey_identical_with_and_without_empty_spec(self, monkeypatch):
+        rng = np.random.RandomState(34)
+        spec = _make_spec(0, rng)
+        baseline = survey.survey_measure_toas([spec], phShiftRes=200)
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "")  # set-but-empty == unset
+        frames = survey.survey_measure_toas([spec], phShiftRes=200)
+        _assert_bitwise(frames[0], baseline[0], spec.name)
+        assert survey.last_survey_info()["demoted"] == {}
+
+
+# ---------------------------------------------------------------------------
+# telemetry never fails a run / manifest + ledger integration
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetryAndLedger:
+    def test_unwritable_obs_dir_never_fails_the_run(self, monkeypatch,
+                                                    tmp_path):
+        blocker = tmp_path / "obs_is_a_file"
+        blocker.write_text("not a directory")
+        monkeypatch.setenv("CRIMP_TPU_OBS", "1")
+        monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(blocker))
+        with obs.run("doomed_io") as rec:
+            obs.counter_add("work", 1)  # in-memory state still accumulates
+        assert rec.counters["work"] == 1
+        assert rec.counters["telemetry_write_errors"] >= 1
+        assert obs.last_manifest_path() is None  # nothing written, no raise
+
+    def test_mark_degraded_lands_in_valid_manifest(self, obs_on):
+        with obs.run("degraded_run"):
+            policy.record_degradation("fold", "exact_refold",
+                                      FailureKind.RESOURCE_EXHAUSTED)
+        doc = load_manifest(obs.last_manifest_path())  # raises if invalid
+        assert doc["degraded"] is True
+        assert doc["degradations"] == ["fold:exact_refold:resource_exhausted"]
+        assert doc["counters"]["degradations"] == 1
+
+    def test_ledger_excludes_degraded_from_green_baseline(self):
+        assert ledger.classify({"platform": "tpu", "degraded": True}) \
+            == "degraded"
+        assert "degraded" not in ledger.GREEN_CLASSES
+
+    def test_quarantine_counts_when_obs_active(self, obs_on, tmp_path):
+        victim = tmp_path / "x.json"
+        victim.write_text("junk")
+        with obs.run("q"):
+            target = policy.quarantine_file(victim, label="tuner_cache")
+        assert target == str(victim) + ".corrupt"
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["counters"]["quarantined_files"] == 1
+        assert doc["counters"]["quarantined_tuner_cache"] == 1
+
+    def test_quarantine_of_missing_file_returns_none(self, tmp_path):
+        assert policy.quarantine_file(tmp_path / "ghost.npz") is None
+
+
+class TestPinnedCpu:
+    def test_pinned_cpu_runs_and_stamps_device_rung(self, obs_on):
+        with obs.run("cpu_rung"):
+            with policy.pinned_cpu(FailureKind.DEVICE_LOST):
+                x = jax.numpy.arange(4).sum()
+        assert int(x) == 6
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["counters"]["degraded_device_cpu_pinned"] == 1
+        assert "device:cpu_pinned:device_lost" in doc["degradations"]
