@@ -1036,6 +1036,28 @@ def main():
         # obs is off but an earlier run in this process recorded one
         return obs.last_manifest_path() if _obs_run is not None else None
 
+    def roofline_summary():
+        """Headline roofline numbers from this run's manifest, or None."""
+        path = obs_manifest_path()
+        if not path:
+            return None
+        try:
+            from crimp_tpu.obs import roofline
+            from crimp_tpu.obs.manifest import load_manifest
+
+            analysis = roofline.analyze(load_manifest(path))
+            if not analysis["rows"]:
+                return None
+            return {
+                "kernels": len(analysis["rows"]),
+                "worst_pct": analysis["worst_pct"],
+                "best_pct": analysis["best_pct"],
+                "device_kind": analysis["device_kind"],
+            }
+        except Exception as exc:  # noqa: BLE001 - telemetry is optional
+            log(f"[bench] roofline summary unavailable: {exc}")
+            return None
+
     here = pathlib.Path(__file__).parent
     par = str(here / "tests/data/1e2259.par")
     intervals_path = str(here / "tests/data/timIntToAs_1e2259.txt")
@@ -1176,6 +1198,10 @@ def main():
         "platform_fallback": platform_fallback,
         "obs_manifest": obs_manifest_path(),
         "obs_schema_version": obs.OBS_SCHEMA_VERSION,
+        # per-kernel efficiency-of-peak headline (obs/roofline.py joins the
+        # manifest's cost-model rows against measured spans); recorded, not
+        # baseline-gated
+        "roofline": roofline_summary(),
         "cpu_scaled_workloads": on_cpu,
         "north_star_trials": north["n_trials_2d"] if north else None,
         "north_star_poly_trig": use_poly,

@@ -149,6 +149,14 @@ REGISTRY: dict[str, Knob] = _build_registry((
          consumer="crimp_tpu/obs/heartbeat.py",
          doc="heartbeat period: progress/ETA events + an atomically "
              "rewritten sidecar; 0/off disables"),
+    Knob("CRIMP_TPU_OBS_COST", "on (when obs is on)", "bool",
+         consumer="crimp_tpu/obs/costmodel.py",
+         doc="XLA cost-model capture (flops/bytes per jitted kernel) feeding "
+             "the manifest costmodel table and `obs roofline`; 0 disables"),
+    Knob("CRIMP_TPU_HBM_WARN_PCT", "90", "float",
+         consumer="crimp_tpu/obs/core.py",
+         doc="warn (once per run) when device peak_bytes_in_use exceeds this "
+             "percent of bytes_limit at a stage boundary; 0 disables"),
     Knob("CRIMP_TPU_OBS_LEDGER", "unset (off)", "path",
          consumer="bench.py + crimp_tpu/obs/ledger.py",
          doc="append-only performance-ledger JSONL; bench.py appends its "

@@ -15,6 +15,11 @@ Three pieces (docs/observability.md has the full contracts):
   crimp_tpu.obs``): summarize a manifest, diff two runs (span-level
   slowdown attribution, counter deltas, knob drift), export Chrome
   trace-event JSON and Prometheus text exposition.
+- **Cost model + roofline** (:mod:`crimp_tpu.obs.costmodel`,
+  :mod:`crimp_tpu.obs.roofline`): XLA ``cost_analysis``/``memory_analysis``
+  rows per jitted kernel (cached through the autotune machinery), HBM
+  watermarks at stage boundaries, and the ``obs roofline`` join that turns
+  measured span seconds into achieved FLOP/s and %-of-peak.
 - **Live + longitudinal layer**: :mod:`crimp_tpu.obs.heartbeat`
   (periodic progress/ETA events + an atomic sidecar, the default
   ``progress`` of long scans), :mod:`crimp_tpu.obs.salvage`
@@ -40,14 +45,16 @@ from crimp_tpu.obs.core import (  # noqa: F401
     OBS_SCHEMA_VERSION,
     active,
     counter_add,
+    current_span_name,
     enabled,
     gauge_set,
     last_manifest_path,
     mark_degraded,
+    record_cost,
     record_numeric_mode,
     record_span,
     run,
     span,
 )
-from crimp_tpu.obs import heartbeat  # noqa: F401
+from crimp_tpu.obs import costmodel, heartbeat  # noqa: F401
 from crimp_tpu.obs.heartbeat import beat  # noqa: F401

@@ -6,6 +6,8 @@ Subcommands:
 - ``diff A B``                attribute A→B slowdown; flag knob/numeric drift
 - ``trace MANIFEST [-o OUT]`` export Chrome trace-event JSON (Perfetto)
 - ``prom MANIFEST [-o OUT]``  export Prometheus text exposition
+- ``roofline MANIFEST``       join cost-model rows x span durations into a
+                              per-kernel %-of-peak table (``--fail-below``)
 - ``validate MANIFEST``       schema-check a manifest
 - ``salvage EVENTS``          reconstruct a manifest from a killed run's
                               event stream (``"salvaged": true``)
@@ -13,8 +15,9 @@ Subcommands:
 - ``ledger add|show|check``   the append-only performance ledger
 
 Exit codes: 0 = ok, 1 = validation problems / drift found with
-``--fail-on-drift`` / regression with ``--fail-on-regression`` / tail
-without a run end, 2 = usage or I/O error.
+``--fail-on-drift`` / regression with ``--fail-on-regression`` / roofline
+worst kernel below ``--fail-below`` / tail without a run end, 2 = usage
+or I/O error.
 """
 
 from __future__ import annotations
@@ -25,6 +28,7 @@ import sys
 
 from crimp_tpu.obs import ledger as ldg
 from crimp_tpu.obs import report as rpt
+from crimp_tpu.obs import roofline as rfl
 from crimp_tpu.obs import salvage as slv
 from crimp_tpu.obs.manifest import load_manifest, validate_manifest
 
@@ -56,6 +60,15 @@ def build_parser() -> argparse.ArgumentParser:
     m = sub.add_parser("prom", help="export Prometheus text exposition")
     m.add_argument("manifest")
     m.add_argument("-o", "--out", default=None, help="output path (default stdout)")
+
+    r = sub.add_parser(
+        "roofline", help="per-kernel achieved FLOP/s, intensity and "
+                         "%-of-peak from the manifest's cost-model rows")
+    r.add_argument("manifest")
+    r.add_argument("--format", choices=("text", "json"), default="text")
+    r.add_argument("--fail-below", type=float, default=None, metavar="PCT",
+                   help="exit 1 when the worst measured kernel sits below "
+                        "this percent of its roofline")
 
     v = sub.add_parser("validate", help="schema-check a manifest")
     v.add_argument("manifest")
@@ -197,6 +210,27 @@ def main(argv: list[str] | None = None) -> int:
         if args.cmd == "prom":
             doc = load_manifest(args.manifest)
             _write(rpt.prometheus(doc), args.out)
+            return 0
+
+        if args.cmd == "roofline":
+            doc = load_manifest(args.manifest)
+            analysis = rfl.analyze(doc)
+            if args.format == "json":
+                print(json.dumps(analysis, indent=2))
+            else:
+                print(rfl.render(analysis))
+            if args.fail_below is not None:
+                worst = analysis.get("worst_pct")
+                if worst is None:
+                    print("obs roofline: --fail-below set but no kernel had "
+                          "both a cost row and a measured span",
+                          file=sys.stderr)
+                    return 1
+                if worst < args.fail_below:
+                    print(f"obs roofline: worst kernel {worst:.2f}% of roof "
+                          f"< --fail-below {args.fail_below:g}%",
+                          file=sys.stderr)
+                    return 1
             return 0
 
         if args.cmd == "salvage":

@@ -119,6 +119,10 @@ class Span:
             "thread": rec._thread_ordinal(),
             "attrs": dict(attrs),
         }
+        if kind == "stage":
+            stats = _hbm_stats()
+            if stats and isinstance(stats.get("bytes_in_use"), (int, float)):
+                self._row["attrs"]["hbm_enter_bytes"] = stats["bytes_in_use"]
         with _LOCK:
             self.index = len(rec.spans)
             rec.spans.append(self._row)
@@ -145,6 +149,15 @@ class Span:
         self._row["dur_s"] = round(dur, 6)
         if exc_type is not None:
             self._row["attrs"]["error"] = f"{exc_type.__name__}: {exc}"
+        if self._row["kind"] == "stage":
+            stats = _hbm_stats()
+            if stats:
+                if isinstance(stats.get("bytes_in_use"), (int, float)):
+                    self._row["attrs"]["hbm_exit_bytes"] = stats["bytes_in_use"]
+                if isinstance(stats.get("peak_bytes_in_use"), (int, float)):
+                    self._row["attrs"]["hbm_peak_bytes"] = \
+                        stats["peak_bytes_in_use"]
+                self._rec._hbm_update(stats)
         self._rec._emit({"ev": "span", "i": self.index, **self._row})
         return False
 
@@ -170,6 +183,7 @@ class RunRecorder:
         self.dir = knobs.env_str("CRIMP_TPU_OBS_DIR", "obs_runs")
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
+        self.costmodel: dict[str, dict] = {}
         self.numeric_mode: dict | None = None
         self.error: str | None = None
         self.degraded: list[str] = []
@@ -180,6 +194,8 @@ class RunRecorder:
         self._threads: dict[int, int] = {threading.get_ident(): 0}
         self._events = None
         self.hb = None  # lazy per-run heartbeat state (obs/heartbeat.py)
+        self.hbm_start = _hbm_stats()  # None on CPU / no accelerator
+        self._hbm_warned = False
         try:
             os.makedirs(self.dir, exist_ok=True)
             if knobs.env_onoff("CRIMP_TPU_OBS_EVENTS") is not False:
@@ -250,8 +266,39 @@ class RunRecorder:
             "compile": _compile_snapshot(),
             "counters": dict(self.counters),
             "gauges": dict(self.gauges),
+            "costmodel": dict(self.costmodel),
             "spans": list(self.spans),
         }
+
+    def _hbm_update(self, stats: dict) -> None:
+        """Fold one device memory_stats sample into the run's HBM gauges.
+
+        Tracks the run-wide high water (``hbm_peak_bytes``) and warns —
+        once per run — when the device's own peak crosses the
+        CRIMP_TPU_HBM_WARN_PCT fraction of its byte limit.
+        """
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use", in_use)
+        limit = stats.get("bytes_limit")
+        with _LOCK:
+            if isinstance(in_use, (int, float)):
+                self.gauges["hbm_bytes_in_use"] = in_use
+            if isinstance(peak, (int, float)):
+                prev = self.gauges.get("hbm_peak_bytes", 0)
+                self.gauges["hbm_peak_bytes"] = max(prev, peak)
+        if (not self._hbm_warned and isinstance(peak, (int, float))
+                and isinstance(limit, (int, float)) and limit > 0):
+            warn_pct = knobs.env_float("CRIMP_TPU_HBM_WARN_PCT", 90.0)
+            pct = 100.0 * peak / limit
+            if warn_pct > 0 and pct >= warn_pct:
+                self._hbm_warned = True
+                with _LOCK:
+                    self.counters["hbm_warn_trips"] = \
+                        self.counters.get("hbm_warn_trips", 0) + 1
+                logger.warning(
+                    "HBM high water %.1f%% of limit (%d / %d bytes) — above "
+                    "CRIMP_TPU_HBM_WARN_PCT=%g", pct, peak, limit, warn_pct)
+                self._emit({"ev": "ctr", "k": "hbm_warn_trips", "v": 1})
 
     def finalize(self) -> str | None:
         """Close the root span, write the manifest atomically, return its path.
@@ -259,6 +306,18 @@ class RunRecorder:
         Returns None (and logs) when the obs dir rejects the write — a run
         that computed correctly must not die on its telemetry epilogue.
         """
+        end = _hbm_stats()
+        if end and isinstance(end.get("bytes_in_use"), (int, float)):
+            with _LOCK:
+                self.gauges["hbm_run_end_bytes"] = end["bytes_in_use"]
+                start = (self.hbm_start or {}).get("bytes_in_use")
+                if isinstance(start, (int, float)):
+                    # held-buffer delta across the run: a persistent growth
+                    # here is the leak signal (caches are expected to show
+                    # a bounded, explainable delta)
+                    self.gauges["hbm_leak_bytes"] = end["bytes_in_use"] - start
+            self._emit({"ev": "gauge", "k": "hbm_run_end_bytes",
+                        "v": end["bytes_in_use"]})
         with _LOCK:
             if self.spans[0]["dur_s"] is None:
                 self.spans[0]["dur_s"] = round(time.perf_counter() - self.t0, 6)
@@ -335,6 +394,32 @@ def _platform_identity() -> dict:
     return out
 
 
+def _hbm_stats() -> dict | None:
+    """One ``device.memory_stats()`` sample from the live backend, or None.
+
+    Same never-initialize contract as :func:`_platform_identity`: only
+    backends some other code already brought up are consulted, and CPU
+    devices (whose ``memory_stats`` returns None or raises) degrade to
+    None — the HBM gauges simply don't exist for CPU runs.
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        from jax._src import xla_bridge
+        backends = getattr(xla_bridge, "_backends", None) or {}
+        for backend in backends.values():
+            for d in backend.devices():
+                stats = d.memory_stats()
+                if stats:
+                    return {"bytes_in_use": stats.get("bytes_in_use"),
+                            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+                            "bytes_limit": stats.get("bytes_limit")}
+    except Exception:  # noqa: BLE001 — watermarks are best-effort telemetry  # graftlint: disable=GL006 (telemetry guard: memory_stats is backend-dependent; HBM sampling must never fail a span)
+        pass
+    return None
+
+
 def _compile_snapshot() -> dict | None:
     """The compile-cache telemetry, when the profiling listeners exist."""
     try:
@@ -409,6 +494,36 @@ def record_span(name: str, dur_s: float, kind: str = "kernel", **attrs) -> None:
         idx = len(rec.spans)
         rec.spans.append(row)
     rec._emit({"ev": "span", "i": idx, **row})
+
+
+def current_span_name(default: str | None = None) -> str | None:
+    """Leaf name of the calling thread's innermost open span (the run root
+    when none is open on this thread); ``default`` when no run is active."""
+    rec = _RUN
+    if rec is None:
+        return default
+    stack = _stack()
+    idx = stack[-1] if stack else 0
+    try:
+        return rec.spans[idx]["name"]
+    except (IndexError, KeyError):
+        return default
+
+
+def record_cost(name: str, row: dict) -> None:
+    """Attach one cost-model row to the active run (no-op when none).
+
+    Keyed by kernel name — the same name the span layer sees — so the
+    roofline join is a plain dict lookup. Last capture wins; the rows are
+    per-(shape, platform) properties of the executable, so a re-capture
+    under the same run is the same row (or a deliberate shape change).
+    """
+    rec = _RUN
+    if rec is None:
+        return
+    with _LOCK:
+        rec.costmodel[str(name)] = dict(row)
+    rec._emit({"ev": "cost", "k": str(name), "row": dict(row)})
 
 
 def counter_add(name: str, value: float = 1) -> None:

@@ -1,0 +1,247 @@
+"""XLA cost-model capture: flops/bytes per jitted kernel, from the compiler.
+
+The flight recorder knows how LONG a kernel ran; this module captures how
+much WORK the compiled executable represents — ``cost_analysis()`` (flops,
+bytes accessed, transcendentals) and ``memory_analysis()`` (argument/
+output/temp/generated-code bytes) from the AOT-compiled form of the same
+jitted function the call site just dispatched. :mod:`crimp_tpu.obs.roofline`
+joins these rows against measured span durations to turn raw seconds into
+achieved FLOP/s, arithmetic intensity and %-of-peak — the "as fast as the
+hardware allows" metric the ROADMAP north star actually asks for.
+
+Contracts (pinned by tests/test_costmodel.py):
+
+- **Disabled is free.** With no active obs run, :func:`capture` returns
+  after one ``active() is None`` check — it never touches the function,
+  the arguments, or jax. ``CRIMP_TPU_OBS_COST=0`` disables capture while
+  the rest of obs stays on (malformed values raise, like every knob).
+- **Repeat shapes cost nothing.** Rows are cached per
+  (kernel, platform, arg shapes/dtypes/statics, numeric-mode knobs)
+  fingerprint: an in-process dict first, then the autotune cache file
+  (``cost|``-prefixed keys ride the same atomic-rename JSON the tuner
+  winners live in), so a re-run of a tuned shape never re-lowers.
+- **Never raises, never recomputes.** Lowering happens on abstract
+  ``ShapeDtypeStruct`` stand-ins (no device buffers are touched, donated
+  arguments included), and the AOT compile lands in the same executable
+  cache the runtime call already populated. Backends without
+  ``cost_analysis``/``memory_analysis`` (CPU PJRT versions vary) degrade
+  to partial rows; any failure degrades to "no row", counted in
+  ``costmodel_capture_errors``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import sys
+
+from crimp_tpu import knobs
+from crimp_tpu.obs import core as obs_core
+
+logger = logging.getLogger("crimp_tpu.obs.costmodel")
+
+# One in-process row cache per fingerprint; shared across runs (the row is
+# a property of the compiled executable, not of any particular run).
+_MEM_CACHE: dict[str, dict] = {}
+
+
+def cost_capture_on() -> bool:
+    """Whether CRIMP_TPU_OBS_COST asks for capture (default on; malformed
+    raises — the knob-registry typo discipline)."""
+    return knobs.env_onoff("CRIMP_TPU_OBS_COST") is not False
+
+
+def _platform_peek() -> str:
+    """``backend|device_kind`` from already-initialized backends only.
+
+    Same never-initialize contract as ``obs.core._platform_identity``:
+    capture runs right after a kernel dispatch, so a backend is live in
+    practice — but cost capture must never be the thing that brings one up.
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "none|none"
+    try:
+        from jax._src import xla_bridge
+        backends = getattr(xla_bridge, "_backends", None) or {}
+        for plat, backend in backends.items():
+            devs = backend.devices()
+            kind = getattr(devs[0], "device_kind", "") if devs else ""
+            return f"{plat}|{kind}"
+    except Exception:  # noqa: BLE001 — identity is best-effort telemetry  # graftlint: disable=GL006 (telemetry guard: platform peek must never fail a capture)
+        pass
+    return "none|none"
+
+
+def _leaf_sig(leaf) -> str:
+    """One fingerprint token per argument leaf: shape+dtype for arrays,
+    repr for plain statics, type name for anything opaque."""
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    if isinstance(leaf, (bool, int, float, complex, str, bytes, type(None))):
+        return repr(leaf)
+    return type(leaf).__name__
+
+
+def _numeric_knob_sig() -> str:
+    """Set numeric-affecting knobs, so a numeric-mode flip (poly trig,
+    delta-fold budget, ...) can never alias a cached cost row."""
+    parts = []
+    for name in sorted(knobs.REGISTRY):
+        if knobs.REGISTRY[name].numeric:
+            val = knobs.raw(name)
+            if val:
+                parts.append(f"{name}={val}")
+    return ";".join(parts)
+
+
+def fingerprint(name: str, args: tuple, kwargs: dict) -> str:
+    """``cost|<platform>|<device_kind>|<kernel>|<sha>`` — the disk-cache key.
+
+    The sha covers every argument leaf's shape/dtype (or static value) plus
+    the set numeric-mode knobs; the readable prefix keeps the shared
+    autotune cache file greppable.
+    """
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    body = "|".join([str(treedef), _numeric_knob_sig()]
+                    + [_leaf_sig(leaf) for leaf in leaves])
+    sha = hashlib.sha1(body.encode()).hexdigest()[:16]
+    return f"cost|{_platform_peek()}|{name}|{sha}"
+
+
+def _abstractify(x):
+    """Array leaves -> ShapeDtypeStruct so lowering never touches buffers
+    (donated streamed-carry arguments included); statics pass through."""
+    import jax
+
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+    return x
+
+
+def analyze(fn, args: tuple, kwargs: dict) -> dict:
+    """Lower + AOT-compile ``fn`` on abstract stand-ins; extract the row.
+
+    The AOT compile lands in the same executable cache the runtime call
+    already populated, so for a kernel that just ran this costs one
+    retrace, not a recompile. Missing analyses (backend-dependent) leave
+    their fields None — a partial row, never an exception out of here
+    beyond what :func:`capture` swallows.
+    """
+    import jax
+
+    aargs = jax.tree_util.tree_map(_abstractify, args)
+    akwargs = jax.tree_util.tree_map(_abstractify, kwargs)
+    compiled = fn.lower(*aargs, **akwargs).compile()
+    row: dict = {"flops": None, "bytes_accessed": None, "transcendentals": None,
+                 "argument_bytes": None, "output_bytes": None,
+                 "temp_bytes": None, "peak_bytes": None,
+                 "generated_code_bytes": None}
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — backend-dependent analysis  # graftlint: disable=GL006 (telemetry guard: cost_analysis is absent on some PJRT backends; partial rows are the contract)
+        ca = None
+    if isinstance(ca, (list, tuple)):  # jax<=0.4.x returns [dict]
+        ca = ca[0] if ca else None
+    if isinstance(ca, dict):
+        for field, key in (("flops", "flops"),
+                           ("bytes_accessed", "bytes accessed"),
+                           ("transcendentals", "transcendentals")):
+            val = ca.get(key)
+            if isinstance(val, (int, float)):
+                row[field] = float(val)
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — backend-dependent analysis  # graftlint: disable=GL006 (telemetry guard: memory_analysis is absent on some PJRT backends; partial rows are the contract)
+        ma = None
+    if ma is not None:
+        for field, attr in (
+                ("argument_bytes", "argument_size_in_bytes"),
+                ("output_bytes", "output_size_in_bytes"),
+                ("temp_bytes", "temp_size_in_bytes"),
+                ("peak_bytes", "peak_memory_in_bytes"),
+                ("generated_code_bytes", "generated_code_size_in_bytes")):
+            val = getattr(ma, attr, None)
+            if isinstance(val, (int, float)):
+                row[field] = int(val)
+        if row["peak_bytes"] is None and row["temp_bytes"] is not None:
+            # older jax has no peak field: argument+output+temp is the
+            # executable's simultaneous-buffer upper bound
+            row["peak_bytes"] = sum(row[f] or 0 for f in
+                                    ("argument_bytes", "output_bytes",
+                                     "temp_bytes"))
+    return row
+
+
+def capture(name: str, fn, *args, **kwargs) -> dict | None:
+    """Record the cost-model row for one jitted call under span name ``name``.
+
+    Call sites invoke this right after dispatching ``fn(*args, **kwargs)``
+    with the SAME arguments. Returns the row (also recorded on the active
+    run, keyed so ``obs roofline`` can join it against the span rollup),
+    or None: no active run, capture knob off, or a capture failure — in
+    which case the pipeline proceeds untouched.
+    """
+    rec = obs_core.active()
+    if rec is None:
+        return None
+    if not cost_capture_on():
+        return None
+    try:
+        key = fingerprint(name, args, kwargs)
+        row = _MEM_CACHE.get(key)
+        cache = "mem"
+        if row is None:
+            row = _disk_get(key)
+            cache = "disk"
+        if row is None:
+            row = analyze(fn, args, kwargs)
+            cache = "miss"
+            _disk_put(key, row)
+        _MEM_CACHE[key] = row
+        out = dict(row)
+        out["fingerprint"] = key
+        out["cache"] = cache
+        span = obs_core.current_span_name()
+        if span:
+            out["span"] = span
+        obs_core.record_cost(name, out)
+        obs_core.counter_add("costmodel_rows")
+        return out
+    except Exception as exc:  # noqa: BLE001 — capture must never fail the kernel that just succeeded  # graftlint: disable=GL006 (telemetry guard: cost capture degrades to no-row; obs cannot import resilience without a cycle)
+        logger.debug("cost capture failed for %s: %s", name, exc)
+        obs_core.counter_add("costmodel_capture_errors")
+        return None
+
+
+# -- disk tier (the autotune cache file, "cost|" keys) ----------------------
+
+
+def _disk_get(key: str) -> dict | None:
+    from crimp_tpu.ops import autotune
+
+    entry = autotune._load_cache().get(key)
+    if not isinstance(entry, dict):
+        return None
+    return {k: v for k, v in entry.items()
+            if k not in ("fingerprint", "cache", "span")}
+
+
+def _disk_put(key: str, row: dict) -> None:
+    from crimp_tpu.ops import autotune
+
+    try:
+        autotune._store_entry(key, row)
+    except OSError:
+        # a read-only or full cache dir just means no persistence tier;
+        # the in-process cache still dedups this run
+        logger.debug("cost cache store failed for %s", key)
+
+
+def reset_mem_cache() -> None:
+    """Test hook: forget every in-process row."""
+    _MEM_CACHE.clear()

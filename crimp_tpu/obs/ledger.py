@@ -145,6 +145,21 @@ def _entry_from_manifest(doc: dict, source: str) -> dict:
     wall = doc.get("wall_s")
     if isinstance(wall, (int, float)):
         metrics["run_wall_s"] = float(wall)
+    # cost-model extensions: recorded for longitudinal history, but NOT
+    # in METRICS — check() skips them, so they cannot gate a round yet
+    hbm_peak = (doc.get("gauges") or {}).get("hbm_peak_bytes")
+    if isinstance(hbm_peak, (int, float)):
+        metrics["hbm_peak_bytes"] = float(hbm_peak)
+    if doc.get("costmodel"):
+        try:
+            from crimp_tpu.obs import roofline
+            analysis = roofline.analyze(doc)
+            for key in ("worst_pct", "best_pct"):
+                val = analysis.get(key)
+                if isinstance(val, (int, float)):
+                    metrics[f"roofline_{key}"] = float(val)
+        except Exception:  # noqa: BLE001 — a sparse manifest yields no roofline metric, never a failed ingest  # graftlint: disable=GL006 (telemetry guard: roofline join is optional ledger enrichment)
+            pass
     return {
         "schema": LEDGER_SCHEMA, "v": LEDGER_SCHEMA_VERSION,
         "kind": "obs_manifest", "source": source,

@@ -72,6 +72,18 @@ def validate_manifest(doc) -> list[str]:
         problems.append(
             f"'degradations' is {type(doc['degradations']).__name__}, "
             "expected list")
+    # optional extension (PR-10 cost-model layer; older manifests lack it)
+    if "costmodel" in doc:
+        cm = doc["costmodel"]
+        if not isinstance(cm, dict):
+            problems.append(
+                f"'costmodel' is {type(cm).__name__}, expected object")
+        else:
+            for key, row in cm.items():
+                if not isinstance(row, dict):
+                    problems.append(
+                        f"costmodel[{key!r}] is {type(row).__name__}, "
+                        "expected object")
     if doc.get("schema") not in (None, OBS_SCHEMA):
         problems.append(f"schema is {doc.get('schema')!r}, expected {OBS_SCHEMA!r}")
     ver = doc.get("schema_version")

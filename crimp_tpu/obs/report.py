@@ -10,6 +10,7 @@ r3–r5 CPU-fallback benches without hand-diffing JSON.
 from __future__ import annotations
 
 import json
+import math
 
 from crimp_tpu.obs.manifest import span_paths
 
@@ -72,6 +73,10 @@ def summarize(doc: dict, top: int = 12) -> str:
         lines.append("gauges")
         for name, val in sorted(gauges.items()):
             lines.append(f"  {_num(val):>12}  {name}")
+    cm = doc.get("costmodel") or {}
+    if cm:
+        lines.append(f"cost     {len(cm)} kernel cost row(s) "
+                     "(`obs roofline` joins them against span times)")
     comp = doc.get("compile") or {}
     if comp:
         lines.append(
@@ -231,26 +236,48 @@ def _prom_label(val: str) -> str:
     return str(val).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
 
 
+def _prom_num(val) -> str:
+    """A sample value in exposition-format 0.0.4 spelling.
+
+    Python's ``nan``/``inf`` reprs are unparseable to Prometheus — the
+    format wants ``NaN``/``+Inf``/``-Inf``. Finite values keep their
+    native rendering (ints stay ``3``, not ``3.0``). A non-numeric value
+    (a partial/hand-edited manifest) becomes NaN rather than a line the
+    scraper rejects wholesale.
+    """
+    try:
+        num = float(val)
+    except (TypeError, ValueError):
+        return "NaN"
+    if math.isnan(num):
+        return "NaN"
+    if math.isinf(num):
+        return "+Inf" if num > 0 else "-Inf"
+    return str(val)
+
+
 def prometheus(doc: dict) -> str:
     """Prometheus text exposition (format 0.0.4) for one manifest."""
     run = _prom_label(doc["run_id"])
     lines = [
         "# HELP crimp_tpu_run_wall_seconds total wall time of the run",
         "# TYPE crimp_tpu_run_wall_seconds gauge",
-        f'crimp_tpu_run_wall_seconds{{run="{run}"}} {doc["wall_s"]}',
+        f'crimp_tpu_run_wall_seconds{{run="{run}"}} {_prom_num(doc["wall_s"])}',
         "# HELP crimp_tpu_counter_total run counters (events folded, ToAs fit, cache hits, ...)",
         "# TYPE crimp_tpu_counter_total counter",
     ]
     for name, val in sorted((doc.get("counters") or {}).items()):
         lines.append(
-            f'crimp_tpu_counter_total{{run="{run}",name="{_prom_label(name)}"}} {val}')
+            f'crimp_tpu_counter_total{{run="{run}",name="{_prom_label(name)}"}} '
+            f'{_prom_num(val)}')
     lines += [
         "# HELP crimp_tpu_gauge run gauges (padding waste, device counts, ...)",
         "# TYPE crimp_tpu_gauge gauge",
     ]
     for name, val in sorted((doc.get("gauges") or {}).items()):
         lines.append(
-            f'crimp_tpu_gauge{{run="{run}",name="{_prom_label(name)}"}} {val}')
+            f'crimp_tpu_gauge{{run="{run}",name="{_prom_label(name)}"}} '
+            f'{_prom_num(val)}')
     lines += [
         "# HELP crimp_tpu_span_seconds total seconds per span path",
         "# TYPE crimp_tpu_span_seconds gauge",
@@ -259,6 +286,6 @@ def prometheus(doc: dict) -> str:
     ]
     for path, agg in sorted(span_rollup(doc).items()):
         label = f'run="{run}",path="{_prom_label(path)}"'
-        lines.append(f"crimp_tpu_span_seconds{{{label}}} {agg['sum_s']}")
-        lines.append(f"crimp_tpu_span_count{{{label}}} {agg['count']}")
+        lines.append(f"crimp_tpu_span_seconds{{{label}}} {_prom_num(agg['sum_s'])}")
+        lines.append(f"crimp_tpu_span_count{{{label}}} {_prom_num(agg['count'])}")
     return "\n".join(lines) + "\n"

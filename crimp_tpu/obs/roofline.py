@@ -1,0 +1,209 @@
+"""Roofline join: cost-model rows x span durations -> efficiency of peak.
+
+Given a manifest that carries a ``costmodel`` table (obs/costmodel.py) and
+measured kernel spans, compute per-kernel achieved FLOP/s, bytes/s,
+arithmetic intensity, and the fraction of the backend's roofline actually
+reached — plus the compute-vs-memory-bound verdict PulsarX (arXiv
+2309.02544) and the GPU jerk search (arXiv 1911.01353) use to argue about
+their folding/search kernels. Surfaced as ``python -m crimp_tpu.obs
+roofline`` (``--fail-below PCT`` turns the worst measured kernel into a CI
+gate).
+
+Peak-table provenance: per-chip dense bf16/f32 matmul peaks and HBM
+bandwidths from the published Google Cloud TPU spec sheets (v2-v6e). The
+CPU entry is an order-of-magnitude placeholder (one AVX2-class core times
+the virtual-device count is wrong in both directions depending on the
+host) — CPU rows exist so fallback runs still render, but their
+%-of-peak is a sanity indicator, not a measurement. Rows whose kernel has
+cost data but no matching span (or vice versa) degrade to partial rows
+with a null percentage; nothing here raises on a sparse manifest.
+
+Import-safe: no jax, everything computed from the manifest document.
+"""
+
+from __future__ import annotations
+
+from crimp_tpu.obs.manifest import span_paths
+
+# device_kind substring (lowercased, first match wins) -> per-chip peaks.
+# flops = dense matmul peak (bf16 where the generation has MXU bf16,
+# which is what the MXU kernels hit; the VPU f32 paths sit below it),
+# bytes_per_s = HBM bandwidth. Sources: Google Cloud TPU system
+# architecture pages (per-chip numbers), in table order v2..v6e.
+PEAKS: tuple[tuple[str, dict], ...] = (
+    ("v6", {"flops": 918e12, "bytes_per_s": 1.64e12,
+            "source": "TPU v6e spec (bf16 dense, HBM 1640 GB/s)"}),
+    ("v5p", {"flops": 459e12, "bytes_per_s": 2.765e12,
+             "source": "TPU v5p spec (bf16 dense, HBM 2765 GB/s)"}),
+    ("v5", {"flops": 197e12, "bytes_per_s": 8.19e11,
+            "source": "TPU v5e spec (bf16 dense, HBM 819 GB/s)"}),
+    ("v4", {"flops": 275e12, "bytes_per_s": 1.228e12,
+            "source": "TPU v4 spec (bf16 dense, HBM 1228 GB/s)"}),
+    ("v3", {"flops": 123e12, "bytes_per_s": 9.0e11,
+            "source": "TPU v3 spec (bf16 dense, HBM 900 GB/s)"}),
+    ("v2", {"flops": 45e12, "bytes_per_s": 7.0e11,
+            "source": "TPU v2 spec (bf16 dense, HBM 700 GB/s)"}),
+    ("cpu", {"flops": 1e11, "bytes_per_s": 5e10,
+             "source": "CPU fallback placeholder (order of magnitude: one "
+                       "AVX2-class core + DDR channel)"}),
+)
+
+
+def peak_for(platform: dict | None) -> dict | None:
+    """The peak-table entry for a manifest's platform block, or None.
+
+    Matches the first device's ``kind`` first (distinguishes TPU
+    generations), then the backend name (catches bare "cpu").
+    """
+    plat = platform or {}
+    devices = plat.get("devices") or []
+    kind = str((devices[0] or {}).get("kind", "")).lower() if devices else ""
+    backend = str(plat.get("backend") or "").lower()
+    for needle, entry in PEAKS:
+        if needle in kind:
+            return dict(entry)
+    for needle, entry in PEAKS:
+        if needle in backend:
+            return dict(entry)
+    return None
+
+
+def _leaf_rollup(doc: dict) -> dict[str, dict]:
+    """Span durations aggregated by LEAF name (the cost rows' join key).
+
+    The manifest rollup keys on full ``/`` paths; cost rows key on the
+    span name ``profiling.timed()``/``obs.span()`` emitted — the leaf.
+    """
+    out: dict[str, dict] = {}
+    for path, row in zip(span_paths(doc), doc.get("spans") or []):
+        dur = row.get("dur_s")
+        if dur is None:
+            continue
+        leaf = path.rsplit("/", 1)[-1]
+        agg = out.setdefault(leaf, {"sum_s": 0.0, "count": 0})
+        agg["sum_s"] += float(dur)
+        agg["count"] += 1
+    return out
+
+
+def analyze(doc: dict) -> dict:
+    """The roofline join for one manifest.
+
+    Returns ``{"backend", "device_kind", "peak", "rows", "worst_pct"}``.
+    Each row: kernel name, calls, measured seconds, flops/bytes from the
+    cost model, achieved flops/s + bytes/s, arithmetic intensity
+    (flops/byte), ``pct_of_roof`` (achieved flops over the roofline at
+    that intensity — min(peak_flops, intensity * peak_bandwidth)), and
+    ``bound`` ("compute" / "memory" by the ridge point). Fields degrade
+    to None wherever the manifest is partial (CPU rows without
+    cost_analysis, cost rows without a matching span, no peak entry).
+    """
+    plat = doc.get("platform") or {}
+    devices = plat.get("devices") or []
+    kind = (devices[0] or {}).get("kind") if devices else None
+    peak = peak_for(plat)
+    durs = _leaf_rollup(doc)
+    ridge = (peak["flops"] / peak["bytes_per_s"]) if peak else None
+    rows = []
+    for name, cost in sorted((doc.get("costmodel") or {}).items()):
+        if not isinstance(cost, dict):
+            continue
+        agg = durs.get(name)
+        if agg is None and cost.get("span") \
+                and cost["span"] != doc.get("name"):
+            # fall back to the enclosing stage span the row was captured
+            # under — but never to the run root, whose duration is the
+            # whole run and would fabricate a meaningless rate
+            agg = durs.get(str(cost["span"]))
+        dur = agg["sum_s"] if agg else None
+        calls = agg["count"] if agg else 0
+        flops = cost.get("flops")
+        nbytes = cost.get("bytes_accessed")
+        # the cost row is per CALL; the rollup sums over calls
+        tot_flops = flops * calls if isinstance(flops, (int, float)) else None
+        tot_bytes = nbytes * calls if isinstance(nbytes, (int, float)) else None
+        fps = tot_flops / dur if tot_flops is not None and dur else None
+        bps = tot_bytes / dur if tot_bytes is not None and dur else None
+        intensity = (flops / nbytes
+                     if isinstance(flops, (int, float))
+                     and isinstance(nbytes, (int, float)) and nbytes else None)
+        pct = None
+        bound = None
+        if peak and intensity is not None:
+            roof = min(peak["flops"], intensity * peak["bytes_per_s"])
+            bound = "compute" if intensity >= ridge else "memory"
+            if fps is not None and roof > 0:
+                pct = 100.0 * fps / roof
+        rows.append({
+            "name": name,
+            "calls": calls,
+            "sum_s": round(dur, 6) if dur is not None else None,
+            "flops_per_call": flops,
+            "bytes_per_call": nbytes,
+            "flops_per_s": fps,
+            "bytes_per_s": bps,
+            "intensity": round(intensity, 4) if intensity is not None else None,
+            "pct_of_roof": round(pct, 3) if pct is not None else None,
+            "bound": bound,
+            "peak_bytes": cost.get("peak_bytes"),
+            "span": cost.get("span"),
+        })
+    rows.sort(key=lambda r: -(r["sum_s"] or 0.0))
+    pcts = [r["pct_of_roof"] for r in rows if r["pct_of_roof"] is not None]
+    return {
+        "run_id": doc.get("run_id"),
+        "backend": plat.get("backend"),
+        "device_kind": kind,
+        "peak": peak,
+        "rows": rows,
+        "worst_pct": min(pcts) if pcts else None,
+        "best_pct": max(pcts) if pcts else None,
+    }
+
+
+def _eng(val, unit: str) -> str:
+    """Engineering-notation humanization ('1.2 GF/s'); '?' for None."""
+    if not isinstance(val, (int, float)):
+        return "?"
+    for scale, prefix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(val) >= scale:
+            return f"{val / scale:.2f} {prefix}{unit}"
+    return f"{val:.2f} {unit}"
+
+
+def render(analysis: dict, top: int = 20) -> str:
+    """Human-readable roofline table, heaviest kernels first."""
+    peak = analysis.get("peak")
+    lines = [f"run      {analysis.get('run_id') or '?'}",
+             f"backend  {analysis.get('backend') or 'none recorded'}"
+             + (f"  ({analysis['device_kind']})"
+                if analysis.get("device_kind") else "")]
+    if peak:
+        lines.append(
+            f"peaks    {_eng(peak['flops'], 'FLOP/s')}  "
+            f"{_eng(peak['bytes_per_s'], 'B/s')}  "
+            f"ridge {peak['flops'] / peak['bytes_per_s']:.1f} flop/byte  "
+            f"[{peak['source']}]")
+    else:
+        lines.append("peaks    no table entry for this backend; "
+                     "%-of-roof unavailable")
+    rows = analysis.get("rows") or []
+    if not rows:
+        lines.append("no cost-model rows in this manifest (CRIMP_TPU_OBS_COST "
+                     "off, or no instrumented kernels ran)")
+        return "\n".join(lines)
+    lines.append(f"{'kernel':<22} {'calls':>5} {'time':>9} {'flop/call':>10} "
+                 f"{'achieved':>12} {'intens':>7} {'%roof':>6}  bound")
+    for r in rows[:top]:
+        dur = f"{r['sum_s']:.3f}s" if r["sum_s"] is not None else "?"
+        pct = f"{r['pct_of_roof']:.1f}" if r["pct_of_roof"] is not None else "?"
+        lines.append(
+            f"{r['name']:<22} {r['calls']:>5} {dur:>9} "
+            f"{_eng(r['flops_per_call'], 'F'):>10} "
+            f"{_eng(r['flops_per_s'], 'F/s'):>12} "
+            f"{r['intensity'] if r['intensity'] is not None else '?':>7} "
+            f"{pct:>6}  {r['bound'] or '?'}")
+    worst = analysis.get("worst_pct")
+    if worst is not None:
+        lines.append(f"worst measured kernel: {worst:.2f}% of roof")
+    return "\n".join(lines)

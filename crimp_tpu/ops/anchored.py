@@ -40,6 +40,7 @@ import numpy as np
 
 from crimp_tpu import obs
 from crimp_tpu.models import timing
+from crimp_tpu.obs import costmodel
 from crimp_tpu.models.timing import N_FREQ_TERMS, TimingParams
 
 SECONDS_PER_DAY = 86400.0
@@ -372,9 +373,11 @@ def fold_segments(timMod, seg_times, t_ref_mjd=None, delta_fold=None,
 
     def exact():
         am = prepare_anchors(tm, t_ref)
-        return np.asarray(
-            anchored_fold(am, jnp.asarray(delta), jnp.asarray(anchor_idx))
-        )
+        delta_dev = jnp.asarray(delta)
+        idx_dev = jnp.asarray(anchor_idx)
+        out = np.asarray(anchored_fold(am, delta_dev, idx_dev))
+        costmodel.capture("anchored_fold", anchored_fold, am, delta_dev, idx_dev)
+        return out
 
     from crimp_tpu.ops import deltafold
 

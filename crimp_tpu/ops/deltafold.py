@@ -65,6 +65,7 @@ import numpy as np
 
 from crimp_tpu import knobs, obs, resilience
 from crimp_tpu.models import timing
+from crimp_tpu.obs import costmodel
 from crimp_tpu.resilience import faultinject
 from crimp_tpu.models.timing import N_FREQ_TERMS, TimingParams
 
@@ -516,8 +517,10 @@ def cached_fold(tm, times_cat, sizes, t_ref, delta, anchor_idx, exact_fn,
             try:
                 if prod.phases_dev is None:
                     prod.phases_dev = jnp.asarray(prod.phases)
-                folded = np.asarray(refold(prod.phases_dev, basis.b,
-                                           jnp.asarray(dp)))
+                dp_dev = jnp.asarray(dp)
+                folded = np.asarray(refold(prod.phases_dev, basis.b, dp_dev))
+                costmodel.capture("delta_refold", refold,
+                                  prod.phases_dev, basis.b, dp_dev)
                 info["mode"] = "delta"
                 obs.counter_add("delta_fold_refolds")
                 _last_info = info

@@ -45,6 +45,7 @@ import numpy as np
 
 from crimp_tpu import obs
 from crimp_tpu.models import timing
+from crimp_tpu.obs import costmodel
 from crimp_tpu.resilience import faultinject
 from crimp_tpu.ops import anchored, search, toafit
 from crimp_tpu.ops.anchored import AnchoredModel
@@ -280,6 +281,14 @@ def fold_sources(timing_models, seg_times_list, t_ref_list=None):
             sm, delta_pad, idx_pad
         )
         rows = np.asarray(stacked_fold(sm, delta_dev, idx_dev))[:n_real]
+        # cost capture only for unsharded dispatches: abstract stand-ins
+        # lose shardings, so a sharded chunk would cost-model (and
+        # compile) a variant that never ran
+        shards = getattr(getattr(delta_dev, "sharding", None),
+                         "device_set", ())
+        if len(shards) <= 1:
+            costmodel.capture("stacked_fold", stacked_fold,
+                              sm, delta_dev, idx_dev)
         folded_rows.extend(rows)
     phase_lists = []
     t_refs = []
@@ -391,9 +400,12 @@ def fit_sources(kind, tpls, phase_lists, exposure_list, cfg):
             ),
             *tpls,
         )
-        out = fit_toas_batch_multi(kind, tpl_rows, jnp.asarray(phases),
-                                   jnp.asarray(masks), jnp.asarray(exposures),
-                                   cfg)
+        ph = jnp.asarray(phases)
+        mk = jnp.asarray(masks)
+        ex = jnp.asarray(exposures)
+        out = fit_toas_batch_multi(kind, tpl_rows, ph, mk, ex, cfg)
+        costmodel.capture("toa_fit_batch_multi", fit_toas_batch_multi,
+                          kind, tpl_rows, ph, mk, ex, cfg)
     return {k: np.asarray(v) for k, v in out.items()}, slices
 
 

@@ -45,6 +45,7 @@ import numpy as np
 import pandas as pd
 
 from crimp_tpu import knobs, obs, resilience
+from crimp_tpu.obs import costmodel
 from crimp_tpu.ops import fasttrig
 from crimp_tpu.resilience import faultinject
 
@@ -402,10 +403,14 @@ def _grid_sums_dispatch(times, f0, df, n_freq, nharm, poly,
             # one exact-sincos reseed row per `rs` trials per trial block
             obs.counter_add("grid_mxu_reseeds",
                             -(-int(n_freq) // max(1, int(rs))))
+            dev_times = jnp.asarray(times)
             c, s = harmonic_sums_uniform_mxu(
-                jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
+                dev_times, f0, df, n_freq, nharm, eb, tb, poly=poly,
                 reseed=rs, mxu_bf16=b16,
             )
+            costmodel.capture("grid_sums_mxu", harmonic_sums_uniform_mxu,
+                              dev_times, f0, df, n_freq, nharm, eb, tb,
+                              poly=poly, reseed=rs, mxu_bf16=b16)
             return c, s, n
         except Exception as exc:  # noqa: BLE001 — grid ladder: a dead MXU
             # rung drops to the streamed exact-sincos kernel (bit-identical
@@ -424,9 +429,12 @@ def _grid_sums_dispatch(times, f0, df, n_freq, nharm, poly,
                                               resilience.classify(exc2))
     else:
         faultinject.fire("harmonic_sums")
+    dev_times = jnp.asarray(times)
     c, s = harmonic_sums_uniform(
-        jnp.asarray(times), f0, df, n_freq, nharm, eb, tb, poly=poly,
+        dev_times, f0, df, n_freq, nharm, eb, tb, poly=poly,
     )
+    costmodel.capture("grid_sums", harmonic_sums_uniform,
+                      dev_times, f0, df, n_freq, nharm, eb, tb, poly=poly)
     return c, s, n
 
 
@@ -590,10 +598,15 @@ def z2_power_2d_grid(
             times, f0, df, n_freq, fd, nharm, eb, tb, poly=poly,
             reseed=rs, mxu_bf16=b16,
         )
+        costmodel.capture("grid_sums_2d_mxu", harmonic_sums_uniform_2d_mxu,
+                          times, f0, df, n_freq, fd, nharm, eb, tb,
+                          poly=poly, reseed=rs, mxu_bf16=b16)
     else:
         c, s = harmonic_sums_uniform_2d(
             times, f0, df, n_freq, fd, nharm, eb, tb, poly=poly,
         )
+        costmodel.capture("grid_sums_2d", harmonic_sums_uniform_2d,
+                          times, f0, df, n_freq, fd, nharm, eb, tb, poly=poly)
     return jnp.sum(z2_from_sums(c, s, n), axis=1)
 
 
@@ -1144,6 +1157,10 @@ def _streamed_uniform_sums(times, f0, df, n_freq, nharm, event_block,
         nxt = jax.device_put(plan[i + 1][0]) if i + 1 < len(plan) else None
         c, s = update(c, s, dev, n_valid, f0, df, *extra)
         dev = nxt
+    # cost row for the per-chunk carry update (abstract stand-ins, so the
+    # donated carry buffers are never touched); full-chunk shape = plan[0]
+    costmodel.capture("grid_sums_streamed", update,
+                      c, s, plan[0][0], plan[0][1], f0, df, *extra)
     if fdots is None:
         if mxu:
             c_all = c.reshape(nharm, -1)[:, :n_freq]

@@ -44,6 +44,7 @@ import numpy as np
 from jax.scipy.special import i0
 
 from crimp_tpu import obs
+from crimp_tpu.obs import costmodel
 from crimp_tpu.models.profiles import (
     CAUCHY,
     FOURIER,
@@ -772,10 +773,16 @@ def fit_toas_batch_auto(
     cfg = resolve_runtime_cfg(cfg, n_seg, phases.shape[1])
     n_devices = len(jax.devices()) if pmesh.sharding_enabled() else 1
     if n_devices < 2 or n_seg < n_devices:
-        return fit_toas_batch(
-            kind, tpl, jnp.asarray(phases), jnp.asarray(masks),
-            jnp.asarray(exposures), cfg,
-        )
+        ph = jnp.asarray(phases)
+        mk = jnp.asarray(masks)
+        ex = jnp.asarray(exposures)
+        out = fit_toas_batch(kind, tpl, ph, mk, ex, cfg)
+        # cost capture only on this unsharded path: abstract stand-ins
+        # lose shardings, so the sharded path would cost-model a variant
+        # that never ran
+        costmodel.capture("toa_fit_batch", fit_toas_batch,
+                          kind, tpl, ph, mk, ex, cfg)
+        return out
     smesh = pmesh.segment_mesh()
     pad = pmesh.pad_batch_for_mesh(n_seg, smesh)
     if pad:

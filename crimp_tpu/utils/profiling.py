@@ -14,6 +14,7 @@ temporal record is log timestamps). The TPU framework exposes two layers:
 from __future__ import annotations
 
 import contextlib
+import sys
 import threading
 import time
 
@@ -48,6 +49,22 @@ def force(result):
         return result
 
 
+def _trace_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` for an already-imported jax.
+
+    Aligns XLA trace timelines with obs span names without this module
+    ever importing jax itself (``sys.modules`` peek only — a pure-host
+    caller that never touched jax stays jax-free). None when unavailable.
+    """
+    jaxmod = sys.modules.get("jax")
+    if jaxmod is None:
+        return None
+    try:
+        return jaxmod.profiler.TraceAnnotation(str(name))
+    except Exception:  # noqa: BLE001 — trace alignment is best-effort telemetry  # graftlint: disable=GL006 (telemetry guard: TraceAnnotation availability is jax-version-dependent; timing must proceed without it)
+        return None
+
+
 @contextlib.contextmanager
 def timed(name: str, sync=None):
     """Time a block; if ``sync`` is a callable it is invoked at exit to
@@ -56,16 +73,36 @@ def timed(name: str, sync=None):
     Recorded in the legacy per-process registry (``kernel_times()``) and,
     when a flight-recorder run is active, as a ``kind="kernel"`` span of
     the current stage (crimp_tpu.obs supersedes this module's registry;
-    the dict survives as a shim for existing callers)."""
+    the dict survives as a shim for existing callers). A raising body
+    still records its measurement, with an ``error`` attribute on the
+    span — a failed kernel that vanished from the manifest used to be
+    indistinguishable from one that never ran. The block also runs under
+    a ``jax.profiler.TraceAnnotation`` when jax is already imported, so
+    XLA trace timelines carry the same names the spans do."""
     t0 = time.perf_counter()
-    yield
-    if sync is not None:
-        force(sync() if callable(sync) else sync)
-    dt = time.perf_counter() - t0
-    with _TIMES_LOCK:
-        _KERNEL_TIMES.setdefault(name, []).append(dt)
-    obs.record_span(name, dt, kind="kernel")
-    logger.info("[timing] %s: %.3fs", name, dt)
+    annotation = _trace_annotation(name)
+    if annotation is not None:
+        annotation.__enter__()
+    error = None
+    try:
+        yield
+        if sync is not None:
+            force(sync() if callable(sync) else sync)
+    except BaseException as exc:
+        error = f"{type(exc).__name__}: {exc}"
+        raise
+    finally:
+        if annotation is not None:
+            annotation.__exit__(None, None, None)
+        dt = time.perf_counter() - t0
+        with _TIMES_LOCK:
+            _KERNEL_TIMES.setdefault(name, []).append(dt)
+        if error is None:
+            obs.record_span(name, dt, kind="kernel")
+            logger.info("[timing] %s: %.3fs", name, dt)
+        else:
+            obs.record_span(name, dt, kind="kernel", error=error)
+            logger.warning("[timing] %s: %.3fs (FAILED: %s)", name, dt, error)
 
 
 def kernel_times() -> dict[str, list[float]]:
@@ -89,18 +126,28 @@ def install_compile_listeners() -> bool:
 
     Counts ``/jax/compilation_cache/{cache_hits,cache_misses,...}`` events
     and accumulates compile/retrieval durations, so ``compile_counters()``
-    can report persistent-cache effectiveness without parsing logs. Uses
-    ``jax._src.monitoring`` (no public alias in jax 0.4.x) — guarded so a
-    jax upgrade that moves it degrades to "no counters", never to a
-    broken import. Idempotent; installing is config-only (no backend).
+    can report persistent-cache effectiveness without parsing logs. Tries
+    the public ``jax.monitoring`` first and falls back to
+    ``jax._src.monitoring`` (older jax exposed only the private path) —
+    guarded so a jax upgrade that moves either degrades to "no counters",
+    never to a broken import. Idempotent; installing is config-only (no
+    backend).
     """
     global _LISTENERS_INSTALLED
     if _LISTENERS_INSTALLED:
         return True
+    monitoring = None
     try:
-        from jax._src import monitoring
+        from jax import monitoring as public_monitoring
+        if hasattr(public_monitoring, "register_event_listener"):
+            monitoring = public_monitoring
     except ImportError:
-        return False
+        pass
+    if monitoring is None:
+        try:
+            from jax._src import monitoring
+        except ImportError:
+            return False
 
     def _on_event(event: str, **kw) -> None:
         if event.startswith("/jax/compilation_cache/"):

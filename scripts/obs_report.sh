@@ -7,6 +7,7 @@
 #   bash scripts/obs_report.sh diff     obs_runs/<a>.json obs_runs/<b>.json
 #   bash scripts/obs_report.sh trace    obs_runs/<run>.json -o out.json
 #   bash scripts/obs_report.sh prom     obs_runs/<run>.json
+#   bash scripts/obs_report.sh roofline obs_runs/<run>.json --fail-below 1
 #   bash scripts/obs_report.sh validate obs_runs/<run>.json
 #   bash scripts/obs_report.sh tail     obs_runs [--once]
 #   bash scripts/obs_report.sh salvage  obs_runs/<run>.events.jsonl
@@ -15,7 +16,8 @@
 #
 # Exit codes: 0 ok, 1 drift (diff --fail-on-drift) / invalid manifest /
 # regression (ledger check --fail-on-regression) / tail without a run
-# end, 2 usage or I/O error.
+# end / kernel under threshold (roofline --fail-below), 2 usage or I/O
+# error.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."

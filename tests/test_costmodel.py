@@ -1,0 +1,563 @@
+"""Cost-model capture + roofline join (crimp_tpu/obs/{costmodel,roofline}).
+
+The contracts pinned here: capture is a single-check no-op with obs off
+and with CRIMP_TPU_OBS_COST=0 (bit-identical outputs, zero jax work);
+rows are cached per fingerprint (memory, then the autotune cache file)
+so repeat shapes never re-lower; capture failures degrade to "no row",
+never an exception out of the call site; the roofline join never joins
+a cost row against the run root's duration; and the Prometheus exporter
+emits 0.0.4 non-finite literals, not Python reprs.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from crimp_tpu import obs  # noqa: E402
+from crimp_tpu.obs import cli, core, costmodel, report, roofline  # noqa: E402
+from crimp_tpu.obs.manifest import load_manifest, validate_manifest  # noqa: E402
+from crimp_tpu.utils import profiling  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """No run or cached row may leak between tests."""
+    costmodel.reset_mem_cache()
+    yield
+    costmodel.reset_mem_cache()
+    core._RUN = None
+    try:
+        core._TLS.stack.clear()
+    except AttributeError:
+        pass
+
+
+@pytest.fixture
+def obs_on(monkeypatch, tmp_path):
+    out = tmp_path / "obs"
+    monkeypatch.setenv("CRIMP_TPU_OBS", "1")
+    monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(out))
+    # isolate the disk tier too: the cost rows ride the autotune cache
+    monkeypatch.setenv("CRIMP_TPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "autotune.json"))
+    return out
+
+
+@pytest.fixture
+def obs_off(monkeypatch, tmp_path):
+    monkeypatch.delenv("CRIMP_TPU_OBS", raising=False)
+    monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(tmp_path / "obs_absent"))
+    return tmp_path / "obs_absent"
+
+
+def _jitted():
+    return jax.jit(lambda x: jnp.sum(x * 2.0) + jnp.sum(jnp.sin(x)))
+
+
+class _Untouchable:
+    """A stand-in 'function' that fails the test if capture touches it."""
+
+    def __getattr__(self, name):
+        raise AssertionError(f"capture touched .{name} while disabled")
+
+
+# ---------------------------------------------------------------------------
+# Gating: disabled paths do zero work
+# ---------------------------------------------------------------------------
+
+
+class TestCaptureGating:
+    def test_no_active_run_is_a_noop(self, obs_off):
+        # the sentinel would raise on ANY attribute access — capture must
+        # return before even looking at the function or the arguments
+        assert costmodel.capture("k", _Untouchable(), object()) is None
+
+    def test_cost_knob_off_is_a_noop(self, monkeypatch, obs_on):
+        monkeypatch.setenv("CRIMP_TPU_OBS_COST", "0")
+        with obs.run("r") as rec:
+            assert costmodel.capture("k", _Untouchable(), object()) is None
+            assert rec.costmodel == {}
+            assert "costmodel_rows" not in rec.counters
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["costmodel"] == {}
+
+    def test_malformed_cost_knob_raises(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_OBS_COST", "maybe")
+        with pytest.raises(ValueError):
+            costmodel.cost_capture_on()
+
+    def test_capture_failure_degrades_to_no_row(self, obs_on):
+        with obs.run("r") as rec:
+            # a plain function has no .lower -> analyze raises -> swallowed
+            out = costmodel.capture("k", lambda x: x, jnp.zeros(4))
+            assert out is None
+            assert rec.costmodel == {}
+            assert rec.counters.get("costmodel_capture_errors") == 1
+
+
+# ---------------------------------------------------------------------------
+# Capture rows + the two cache tiers
+# ---------------------------------------------------------------------------
+
+
+class TestCaptureRows:
+    def test_row_lands_in_manifest(self, obs_on):
+        fn = _jitted()
+        x = jnp.arange(64, dtype=jnp.float32)
+        with obs.run("r"):
+            fn(x)
+            out = costmodel.capture("unit_kernel", fn, x)
+        assert out is not None
+        assert out["cache"] == "miss"
+        assert out["fingerprint"].startswith("cost|")
+        doc = load_manifest(obs.last_manifest_path())
+        row = doc["costmodel"]["unit_kernel"]
+        # this jax build's CPU backend reports full cost analysis; the
+        # contract is merely "fields exist", partial rows allowed
+        assert set(row) >= {"flops", "bytes_accessed", "fingerprint", "cache"}
+
+    def test_span_attribution(self, obs_on):
+        fn = _jitted()
+        x = jnp.arange(32, dtype=jnp.float32)
+        with obs.run("r"):
+            with obs.span("stage_x"):
+                out = costmodel.capture("k", fn, x)
+        assert out["span"] == "stage_x"
+
+    def test_mem_cache_skips_reanalysis(self, obs_on, monkeypatch):
+        fn = _jitted()
+        x = jnp.arange(16, dtype=jnp.float32)
+        calls = []
+        real = costmodel.analyze
+        monkeypatch.setattr(costmodel, "analyze",
+                            lambda *a: calls.append(1) or real(*a))
+        with obs.run("r"):
+            first = costmodel.capture("k", fn, x)
+            second = costmodel.capture("k", fn, x)
+            other = costmodel.capture("k", fn, jnp.arange(17, dtype=jnp.float32))
+        assert first["cache"] == "miss"
+        assert second["cache"] == "mem"
+        assert other["cache"] == "miss"  # different shape, new fingerprint
+        assert other["fingerprint"] != first["fingerprint"]
+        assert len(calls) == 2
+
+    def test_disk_tier_survives_mem_reset(self, obs_on, monkeypatch):
+        fn = _jitted()
+        x = jnp.arange(16, dtype=jnp.float32)
+        with obs.run("r"):
+            costmodel.capture("k", fn, x)
+        costmodel.reset_mem_cache()  # a "new process"
+        monkeypatch.setattr(costmodel, "analyze",
+                            lambda *a: pytest.fail("disk tier not consulted"))
+        with obs.run("r2"):
+            out = costmodel.capture("k", fn, x)
+        assert out["cache"] == "disk"
+        # the row rides the autotune cache file under a cost| key
+        blob = json.loads(
+            pathlib.Path(str(obs_on.parent / "autotune.json")).read_text())
+        assert any(k.startswith("cost|") for k in blob["entries"])
+
+    def test_fingerprint_covers_numeric_knobs(self, obs_on, monkeypatch):
+        fn = _jitted()
+        x = jnp.arange(16, dtype=jnp.float32)
+        a = costmodel.fingerprint("k", (x,), {})
+        monkeypatch.setenv("CRIMP_TPU_MXU_BF16", "1")  # numeric-mode knob
+        b = costmodel.fingerprint("k", (x,), {})
+        assert a != b
+
+
+# ---------------------------------------------------------------------------
+# Roofline join (pure manifest math, no jax)
+# ---------------------------------------------------------------------------
+
+
+def _doc(costmodel_rows, spans, name="run", kind="TPU v4"):
+    return {
+        "schema": "crimp_tpu.obs", "schema_version": 1, "run_id": "r1",
+        "name": name, "t_start_unix": 0.0, "wall_s": 10.0, "error": None,
+        "platform": {"backend": "tpu", "devices": [{"kind": kind}]},
+        "knobs": {}, "numeric_mode": None, "compile": None,
+        "counters": {}, "gauges": {}, "spans": spans,
+        "costmodel": costmodel_rows,
+    }
+
+
+def _span(name, dur, parent=None, kind="kernel"):
+    return {"name": name, "kind": kind, "t0_s": 0.0, "dur_s": dur,
+            "parent": parent, "thread": 0, "attrs": {}}
+
+
+class TestRoofline:
+    def test_join_math(self):
+        # 2 calls x 1e12 flops / 2e12 bytes-per-call, 2 s total
+        doc = _doc(
+            {"fold": {"flops": 1e12, "bytes_accessed": 2e12, "span": "stage"}},
+            [_span("run", 10.0, kind="run"),
+             _span("stage", 5.0, parent=0, kind="stage"),
+             _span("fold", 1.0, parent=1), _span("fold", 1.0, parent=1)])
+        out = roofline.analyze(doc)
+        (row,) = out["rows"]
+        assert row["calls"] == 2
+        assert row["sum_s"] == 2.0
+        assert row["flops_per_s"] == pytest.approx(1e12)  # 2e12 flops / 2 s
+        assert row["intensity"] == pytest.approx(0.5)
+        # v4 ridge = 275e12/1.228e12 ≈ 224 flop/byte -> far memory-bound;
+        # roof = 0.5 * 1.228e12 = 6.14e11 flop/s
+        assert row["bound"] == "memory"
+        assert row["pct_of_roof"] == pytest.approx(100 * 1e12 / 6.14e11,
+                                                   rel=1e-3)
+        assert out["worst_pct"] == row["pct_of_roof"]
+        assert out["best_pct"] == row["pct_of_roof"]
+
+    def test_compute_bound_verdict(self):
+        doc = _doc(
+            {"mm": {"flops": 1e15, "bytes_accessed": 1e9, "span": None}},
+            [_span("run", 10.0, kind="run"), _span("mm", 2.0, parent=0)])
+        (row,) = roofline.analyze(doc)["rows"]
+        assert row["bound"] == "compute"
+
+    def test_stage_fallback_but_never_run_root(self):
+        spans = [_span("run", 10.0, kind="run"),
+                 _span("stage", 4.0, parent=0, kind="stage")]
+        # row captured under a real stage span: falls back to its duration
+        doc = _doc({"k": {"flops": 8e12, "bytes_accessed": 1e12,
+                          "span": "stage"}}, spans)
+        (row,) = roofline.analyze(doc)["rows"]
+        assert row["sum_s"] == 4.0
+        # row captured at the run root: must NOT inherit the whole-run
+        # duration — that would fabricate a rate
+        doc = _doc({"k": {"flops": 8e12, "bytes_accessed": 1e12,
+                          "span": "run"}}, spans)
+        (row,) = roofline.analyze(doc)["rows"]
+        assert row["sum_s"] is None
+        assert row["pct_of_roof"] is None
+
+    def test_partial_rows_never_raise(self):
+        doc = _doc({"k": {"flops": None, "bytes_accessed": None}},
+                   [_span("run", 1.0, kind="run")], kind="weird-chip")
+        out = roofline.analyze(doc)
+        assert out["peak"] is None
+        (row,) = out["rows"]
+        assert row["pct_of_roof"] is None
+        assert out["worst_pct"] is None
+        assert "no table entry" in roofline.render(out)
+
+    def test_peak_table_lookup(self):
+        v5p = roofline.peak_for({"devices": [{"kind": "TPU v5p"}]})
+        v5e = roofline.peak_for({"devices": [{"kind": "TPU v5 lite"}]})
+        assert v5p["flops"] == pytest.approx(459e12)
+        assert v5e["flops"] == pytest.approx(197e12)  # v5p must not shadow
+        assert roofline.peak_for({"backend": "cpu"}) is not None
+        assert roofline.peak_for({"backend": "quantum"}) is None
+
+    def test_render_table(self):
+        doc = _doc(
+            {"fold": {"flops": 1e12, "bytes_accessed": 2e12, "span": None}},
+            [_span("run", 10.0, kind="run"), _span("fold", 2.0, parent=0)])
+        text = roofline.render(roofline.analyze(doc))
+        assert "fold" in text and "%roof" in text and "memory" in text
+        empty = roofline.render(roofline.analyze(_doc({}, [])))
+        assert "no cost-model rows" in empty
+
+
+class TestRooflineCLI:
+    def _write(self, tmp_path, doc):
+        path = tmp_path / "m.json"
+        path.write_text(json.dumps(doc))
+        return str(path)
+
+    def test_exit_codes(self, tmp_path, capsys):
+        doc = _doc(
+            {"fold": {"flops": 1e12, "bytes_accessed": 2e12, "span": None}},
+            [_span("run", 10.0, kind="run"), _span("fold", 2.0, parent=0)])
+        path = self._write(tmp_path, doc)
+        assert cli.main(["roofline", path]) == 0
+        assert cli.main(["roofline", path, "--fail-below", "0.0001"]) == 0
+        assert cli.main(["roofline", path, "--fail-below", "101"]) == 1
+        out = capsys.readouterr()
+        assert "%roof" in out.out
+        assert "--fail-below" in out.err
+
+    def test_fail_below_with_nothing_measured(self, tmp_path, capsys):
+        path = self._write(tmp_path, _doc({}, [_span("run", 1.0, kind="run")]))
+        assert cli.main(["roofline", path]) == 0  # report-only is fine
+        assert cli.main(["roofline", path, "--fail-below", "1"]) == 1
+
+    def test_json_format(self, tmp_path, capsys):
+        doc = _doc(
+            {"fold": {"flops": 1e12, "bytes_accessed": 2e12, "span": None}},
+            [_span("run", 10.0, kind="run"), _span("fold", 2.0, parent=0)])
+        assert cli.main(["roofline", self._write(tmp_path, doc),
+                         "--format", "json"]) == 0
+        blob = json.loads(capsys.readouterr().out)
+        assert blob["rows"][0]["name"] == "fold"
+
+
+class TestManifestValidation:
+    def test_costmodel_extension_accepted(self):
+        doc = _doc({"k": {"flops": 1.0}}, [_span("run", 1.0, kind="run")])
+        assert validate_manifest(doc) == []
+
+    def test_costmodel_wrong_types_flagged(self):
+        doc = _doc({"k": {"flops": 1.0}}, [_span("run", 1.0, kind="run")])
+        doc["costmodel"] = ["not", "a", "dict"]
+        assert any("costmodel" in p for p in validate_manifest(doc))
+        doc["costmodel"] = {"k": "not a row"}
+        assert any("costmodel" in p for p in validate_manifest(doc))
+
+
+# ---------------------------------------------------------------------------
+# HBM watermarks
+# ---------------------------------------------------------------------------
+
+
+class TestHbmWatermarks:
+    def test_cpu_has_no_stats(self):
+        # CPU PJRT exposes no memory_stats; the sampler must say so quietly
+        assert core._hbm_stats() is None
+
+    def test_stage_spans_carry_watermarks(self, obs_on, monkeypatch):
+        seq = iter([
+            {"bytes_in_use": 100, "peak_bytes_in_use": 100, "bytes_limit": 1000},  # run start
+            {"bytes_in_use": 200, "peak_bytes_in_use": 250, "bytes_limit": 1000},  # stage enter
+            {"bytes_in_use": 150, "peak_bytes_in_use": 400, "bytes_limit": 1000},  # stage exit
+            {"bytes_in_use": 130, "peak_bytes_in_use": 400, "bytes_limit": 1000},  # run end
+        ])
+        monkeypatch.setattr(core, "_hbm_stats", lambda: next(seq, None))
+        with obs.run("r"):
+            with obs.span("stage_a"):
+                pass
+        doc = load_manifest(obs.last_manifest_path())
+        stage = next(s for s in doc["spans"] if s["name"] == "stage_a")
+        assert stage["attrs"]["hbm_enter_bytes"] == 200
+        assert stage["attrs"]["hbm_exit_bytes"] == 150
+        assert stage["attrs"]["hbm_peak_bytes"] == 400
+        assert doc["gauges"]["hbm_peak_bytes"] == 400
+        assert doc["gauges"]["hbm_run_end_bytes"] == 130
+        assert doc["gauges"]["hbm_leak_bytes"] == 30  # 130 end - 100 start
+
+    def test_warn_fires_once_above_threshold(self, obs_on, monkeypatch, caplog):
+        stats = {"bytes_in_use": 950, "peak_bytes_in_use": 950,
+                 "bytes_limit": 1000}
+        with obs.run("r") as rec:
+            with caplog.at_level("WARNING", logger="crimp_tpu.obs"):
+                rec._hbm_update(dict(stats))
+                rec._hbm_update(dict(stats))  # second crossing: silent
+            assert rec.counters.get("hbm_warn_trips") == 1
+        assert sum("HBM" in r.message or "hbm" in r.message
+                   for r in caplog.records) == 1
+
+    def test_warn_disabled_at_zero(self, obs_on, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_HBM_WARN_PCT", "0")
+        with obs.run("r") as rec:
+            rec._hbm_update({"bytes_in_use": 999, "peak_bytes_in_use": 999,
+                             "bytes_limit": 1000})
+            assert "hbm_warn_trips" not in rec.counters
+
+
+class TestSpanNameHelper:
+    def test_no_run_returns_default(self, obs_off):
+        assert core.current_span_name() is None
+        assert core.current_span_name("dflt") == "dflt"
+
+    def test_inside_spans(self, obs_on):
+        with obs.run("r"):
+            assert core.current_span_name() == "r"
+            with obs.span("stage_b"):
+                assert core.current_span_name() == "stage_b"
+
+
+# ---------------------------------------------------------------------------
+# Prometheus export hygiene (satellite: sanitization + non-finite)
+# ---------------------------------------------------------------------------
+
+
+class TestPromHygiene:
+    def test_non_finite_literals(self):
+        assert report._prom_num(float("nan")) == "NaN"
+        assert report._prom_num(float("inf")) == "+Inf"
+        assert report._prom_num(float("-inf")) == "-Inf"
+        assert report._prom_num(None) == "NaN"
+        assert report._prom_num("bogus") == "NaN"
+
+    def test_finite_values_keep_native_rendering(self):
+        assert report._prom_num(3) == "3"      # not 3.0
+        assert report._prom_num(1.5) == "1.5"
+
+    def test_exposition_has_no_python_reprs(self):
+        doc = _doc({}, [_span("run", 1.0, kind="run")])
+        doc["wall_s"] = float("nan")
+        doc["gauges"] = {"g_inf": float("inf"), "g_ninf": float("-inf"),
+                         "g_ok": 7}
+        doc["counters"] = {"c": 3}
+        text = report.prometheus(doc)
+        assert "NaN" in text and "+Inf" in text and "-Inf" in text
+        for token in ("nan", "inf"):  # the unparseable python spellings
+            assert not any(line.endswith(token)
+                           for line in text.splitlines()), token
+        assert 'name="c"} 3' in text
+
+    def test_label_sanitization(self):
+        dirty = 'we"ird\nname\\x'
+        clean = report._prom_label(dirty)
+        assert "\n" not in clean            # raw newline can't split a line
+        assert r"\"" in clean               # quote escaped, not dropped
+        assert r"\n" in clean and r"\\" in clean
+        # a dirty counter name must still yield exactly one sample line
+        doc = _doc({}, [_span("run", 1.0, kind="run")])
+        doc["counters"] = {dirty: 1}
+        text = report.prometheus(doc)
+        lines = [ln for ln in text.splitlines() if "ird" in ln]
+        assert len(lines) == 1
+        assert lines[0].endswith("} 1")
+
+
+# ---------------------------------------------------------------------------
+# timed() error-flag spans + compile listeners (satellites)
+# ---------------------------------------------------------------------------
+
+
+class TestTimedErrorSpans:
+    def test_raising_body_still_records(self, obs_on):
+        with obs.run("r"):
+            with pytest.raises(RuntimeError, match="boom"):
+                with profiling.timed("exploding_kernel"):
+                    raise RuntimeError("boom")
+        assert "exploding_kernel" in profiling.kernel_times()
+        doc = load_manifest(obs.last_manifest_path())
+        row = next(s for s in doc["spans"]
+                   if s["name"] == "exploding_kernel")
+        assert row["kind"] == "kernel"
+        assert row["attrs"]["error"].startswith("RuntimeError")
+
+    def test_clean_body_has_no_error_attr(self, obs_on):
+        with obs.run("r"):
+            with profiling.timed("fine_kernel"):
+                pass
+        doc = load_manifest(obs.last_manifest_path())
+        row = next(s for s in doc["spans"] if s["name"] == "fine_kernel")
+        assert "error" not in row["attrs"]
+
+    def test_failed_sync_is_an_error_span(self, obs_on):
+        def bad_sync():
+            raise ValueError("device gone")
+        with obs.run("r"):
+            with pytest.raises(ValueError):
+                with profiling.timed("sync_fail_kernel", sync=bad_sync):
+                    pass
+        doc = load_manifest(obs.last_manifest_path())
+        row = next(s for s in doc["spans"]
+                   if s["name"] == "sync_fail_kernel")
+        assert row["attrs"]["error"].startswith("ValueError")
+
+
+def test_compile_listeners_prefer_public_api():
+    # jax is importable here, so installation must succeed (public
+    # jax.monitoring on this build; the private fallback covers older jax)
+    assert profiling.install_compile_listeners() is True
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: real pipeline -> manifest -> full reporter chain (slow)
+# ---------------------------------------------------------------------------
+
+
+_E2E_DRIVER = """
+import numpy as np
+import jax.numpy as jnp
+from crimp_tpu import obs
+from crimp_tpu.models import profiles
+from crimp_tpu.ops import anchored, search, toafit
+from crimp_tpu.utils import profiling
+
+FOLD_TM = {"PEPOCH": 58359.55765869704,
+           "F0": 0.14328254547263483, "F1": -9.746993965547238e-15}
+rng = np.random.RandomState(3)
+times = np.sort(rng.uniform(0.0, 400.0, 3000))
+segs = [np.sort(58320.0 + 90.0 * i + rng.uniform(0.0, 80.0, 500))
+        for i in range(3)]
+tpl = profiles.ProfileParams(
+    norm=jnp.asarray(10.0), amp=jnp.asarray([3.0]), loc=jnp.asarray([0.3]),
+    wid=jnp.zeros(1), ph_shift=jnp.asarray(0.0), amp_shift=jnp.asarray(1.0))
+phases = np.mod(rng.vonmises(0.0, 2.0, (3, 256)) / (2 * np.pi) + 0.3, 1.0)
+masks = np.ones_like(phases, dtype=bool)
+exposures = np.full(3, 256 / 10.0)
+cfg = toafit.ToAFitConfig(ph_shift_res=50, n_brute=16, refine_iters=5)
+
+with obs.run("e2e"):
+    with obs.span("z2_scan"):
+        with profiling.timed("grid_scan"):
+            search.z2_power_grid(times, 0.14, 1e-5, 64, nharm=2)
+    with obs.span("fold"):
+        anchored.fold_segments(FOLD_TM, segs)
+    with obs.span("toa_fit"):
+        toafit.fit_toas_batch_auto("fourier", tpl, phases, masks,
+                                   exposures, cfg)
+print(obs.last_manifest_path())
+"""
+
+
+@pytest.mark.slow
+class TestEndToEndReporterChain:
+    def _run(self, argv, env):
+        return subprocess.run(argv, cwd=REPO, env=env,
+                              capture_output=True, text=True, timeout=600)
+
+    def test_pipeline_manifest_drives_every_subcommand(self, tmp_path):
+        import os
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   CRIMP_TPU_OBS="1",
+                   CRIMP_TPU_OBS_DIR=str(tmp_path / "obs"),
+                   CRIMP_TPU_AUTOTUNE_CACHE=str(tmp_path / "at.json"))
+        proc = self._run([sys.executable, "-c", _E2E_DRIVER], env)
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        manifest = proc.stdout.strip().splitlines()[-1]
+
+        # the acceptance criterion: roofline prints per-kernel rows for
+        # the fold, the toafit scan, and the grid kernel
+        roof = self._run([sys.executable, "-m", "crimp_tpu.obs",
+                          "roofline", manifest], env)
+        assert roof.returncode == 0, roof.stderr[-4000:]
+        for kernel in ("anchored_fold", "toa_fit_batch", "grid_sums"):
+            assert kernel in roof.stdout, roof.stdout
+
+        # ... and the rest of the reporter chain accepts the same manifest
+        for argv in (["summary", manifest],
+                     ["diff", manifest, manifest],
+                     ["trace", manifest, "-o", str(tmp_path / "t.json")],
+                     ["prom", manifest],
+                     ["validate", manifest],
+                     ["roofline", manifest, "--format", "json"]):
+            proc = self._run([sys.executable, "-m", "crimp_tpu.obs"] + argv,
+                             env)
+            assert proc.returncode == 0, (argv, proc.stderr[-4000:])
+
+        doc = json.loads(pathlib.Path(manifest).read_text())
+        assert doc["counters"].get("costmodel_rows", 0) >= 3
+
+    def test_obs_off_pipeline_writes_nothing(self, tmp_path):
+        import os
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   CRIMP_TPU_OBS_DIR=str(tmp_path / "obs"),
+                   CRIMP_TPU_AUTOTUNE_CACHE=str(tmp_path / "at.json"))
+        env.pop("CRIMP_TPU_OBS", None)
+        driver = _E2E_DRIVER.replace("print(obs.last_manifest_path())",
+                                     "print(obs.last_manifest_path())"
+                                     "\nassert obs.active() is None")
+        proc = self._run([sys.executable, "-c", driver], env)
+        assert proc.returncode == 0, proc.stderr[-4000:]
+        assert proc.stdout.strip().splitlines()[-1] == "None"
+        assert not (tmp_path / "obs").exists()
