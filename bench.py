@@ -1048,12 +1048,25 @@ def main():
             analysis = roofline.analyze(load_manifest(path))
             if not analysis["rows"]:
                 return None
-            return {
+            summary = {
                 "kernels": len(analysis["rows"]),
                 "worst_pct": analysis["worst_pct"],
                 "best_pct": analysis["best_pct"],
                 "device_kind": analysis["device_kind"],
             }
+            sharded = [r for r in analysis["rows"]
+                       if r.get("devices", 1) > 1]
+            if sharded:
+                # per-device rows exist: record the mesh width and the
+                # worst communication-vs-roofline ratio so multi-chip
+                # regressions are visible in the bench record
+                summary["sharded_kernels"] = len(sharded)
+                summary["devices"] = max(r["devices"] for r in sharded)
+                ratios = [r["comm_vs_roof"] for r in sharded
+                          if r.get("comm_vs_roof") is not None]
+                summary["worst_comm_vs_roof"] = (max(ratios)
+                                                 if ratios else None)
+            return summary
         except Exception as exc:  # noqa: BLE001 - telemetry is optional
             log(f"[bench] roofline summary unavailable: {exc}")
             return None

@@ -36,6 +36,8 @@ RULES: dict[str, str] = {
     "GL005": "order-sensitive reductions in sharded/parity-pinned modules",
     "GL006": "failure-domain discipline: bare `except Exception` must classify "
              "through resilience.taxonomy or carry a waiver reason",
+    "GL007": "sharding-registry discipline: hand-written PartitionSpec outside "
+             "parallel/registry.py needs a waiver",
 }
 
 _RULE_LIST = r"GL\d{3}(?:\s*,\s*GL\d{3})*"
@@ -201,6 +203,8 @@ DEFAULT_GL004_ALLOWLIST = (
 
 DEFAULT_GL005_MODULES = ("crimp_tpu/parallel/",)
 DEFAULT_GL006_MODULES = ("crimp_tpu/",)
+DEFAULT_GL007_MODULES = ("crimp_tpu/",)
+DEFAULT_GL007_REGISTRY = "crimp_tpu/parallel/registry.py"
 
 
 @dataclasses.dataclass
@@ -216,6 +220,8 @@ class Config:
     gl004_allowlist: tuple[str, ...] = DEFAULT_GL004_ALLOWLIST
     gl005_modules: tuple[str, ...] = DEFAULT_GL005_MODULES
     gl006_modules: tuple[str, ...] = DEFAULT_GL006_MODULES
+    gl007_modules: tuple[str, ...] = DEFAULT_GL007_MODULES
+    gl007_registry: str = DEFAULT_GL007_REGISTRY
     rules: tuple[str, ...] | None = None  # None = all
 
     def resolved_registry(self) -> dict:

@@ -20,6 +20,7 @@ RULE_FUNCS = {
     "GL004": rules.rule_gl004,
     "GL005": rules.rule_gl005,
     "GL006": rules.rule_gl006,
+    "GL007": rules.rule_gl007,
 }
 
 

@@ -1,4 +1,4 @@
-"""graftlint rules GL001/GL002/GL004/GL005/GL006 (GL003 lives in knobcheck.py).
+"""graftlint rules GL001/GL002/GL004-GL007 (GL003 lives in knobcheck.py).
 
 Each rule is a function ``(cfg, sources, project) -> list[Finding]``
 over the parsed scan set. The rules encode invariants the repo's kernel
@@ -29,6 +29,11 @@ GL006  failure-domain discipline — a bare ``except Exception`` inside
        policy sees a FailureKind, not a swallowed traceback), bare-
        re-raise it, or carry a waiver stating why this handler is a
        deliberate swallow domain (telemetry guards are the baseline).
+GL007  sharding-registry discipline — ``PartitionSpec(...)`` written by
+       hand anywhere in crimp_tpu/ except parallel/registry.py must
+       carry a waiver: specs scattered across call sites are exactly the
+       bespoke-sharded-twin drift the registry exists to end (dispatch
+       sites ask ``registry.specs_for(kernel, mesh)`` instead).
 """
 
 from __future__ import annotations
@@ -267,6 +272,51 @@ def _gl006_classifies(handler: ast.ExceptHandler) -> bool:
             # failure domain owns classification
             return True
     return False
+
+
+# -- GL007 -------------------------------------------------------------------
+
+
+def _gl007_aliases(tree: ast.AST) -> set[str]:
+    """Local names bound to PartitionSpec by a ``from ...sharding import``
+    (``from jax.sharding import PartitionSpec as P`` is the repo idiom)."""
+    aliases: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom):
+            continue
+        if not str(node.module or "").endswith("sharding"):
+            continue
+        for a in node.names:
+            if a.name == "PartitionSpec":
+                aliases.add(a.asname or a.name)
+    return aliases
+
+
+def rule_gl007(cfg: Config, sources: dict[str, SourceFile],
+               project: Project) -> list[Finding]:
+    out: list[Finding] = []
+    for rel, src in sources.items():
+        if not src.is_python or src.tree is None:
+            continue
+        if rel == cfg.gl007_registry:
+            continue  # the registry is the one sanctioned spec-writing site
+        if not any(rel == m or rel.startswith(m) for m in cfg.gl007_modules):
+            continue
+        aliases = _gl007_aliases(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = call_tail(node.func) == "PartitionSpec" or (
+                isinstance(node.func, ast.Name) and node.func.id in aliases)
+            if hit:
+                out.append(Finding(
+                    "GL007", rel, node.lineno,
+                    "hand-written PartitionSpec outside "
+                    f"{cfg.gl007_registry} — dispatch sites take their specs "
+                    "from registry.specs_for(kernel, mesh) so shardings "
+                    "cannot drift per call site; waive with the reason this "
+                    "spec cannot live in the registry"))
+    return out
 
 
 def rule_gl006(cfg: Config, sources: dict[str, SourceFile],

@@ -153,6 +153,12 @@ REGISTRY: dict[str, Knob] = _build_registry((
          consumer="crimp_tpu/obs/costmodel.py",
          doc="XLA cost-model capture (flops/bytes per jitted kernel) feeding "
              "the manifest costmodel table and `obs roofline`; 0 disables"),
+    Knob("CRIMP_TPU_OBS_HOST", "unset (jax process index)", "int",
+         consumer="crimp_tpu/obs/core.py",
+         doc="host index override for obs artifact suffixing: processes "
+             "sharing CRIMP_TPU_OBS_DIR write host<k>-suffixed event/"
+             "heartbeat/manifest files; unset = jax.process_index() when "
+             "multi-host, else single-host unsuffixed names"),
     Knob("CRIMP_TPU_HBM_WARN_PCT", "90", "float",
          consumer="crimp_tpu/obs/core.py",
          doc="warn (once per run) when device peak_bytes_in_use exceeds this "

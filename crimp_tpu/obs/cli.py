@@ -9,6 +9,10 @@ Subcommands:
 - ``roofline MANIFEST``       join cost-model rows x span durations into a
                               per-kernel %-of-peak table (``--fail-below``)
 - ``validate MANIFEST``       schema-check a manifest
+- ``merge STREAMS...``        join per-host event streams of one
+                              multi-host run into a single validated
+                              manifest (``"merged": true``, per-host
+                              Chrome lanes via ``--trace-out``)
 - ``salvage EVENTS``          reconstruct a manifest from a killed run's
                               event stream (``"salvaged": true``)
 - ``tail TARGET``             follow a live event stream (progress/ETA)
@@ -27,6 +31,7 @@ import json
 import sys
 
 from crimp_tpu.obs import ledger as ldg
+from crimp_tpu.obs import merge as mrg
 from crimp_tpu.obs import report as rpt
 from crimp_tpu.obs import roofline as rfl
 from crimp_tpu.obs import salvage as slv
@@ -72,6 +77,22 @@ def build_parser() -> argparse.ArgumentParser:
 
     v = sub.add_parser("validate", help="schema-check a manifest")
     v.add_argument("manifest")
+
+    mg = sub.add_parser(
+        "merge", help="join per-host event streams of one multi-host run "
+                      "into a single validated manifest")
+    mg.add_argument("streams", nargs="+",
+                    help="per-host *.events.jsonl files, or one run "
+                         "directory (newest run's host group wins)")
+    mg.add_argument("-o", "--out", default=None,
+                    help="output path (default: <run_id>.merged."
+                         "manifest.json next to the first stream)")
+    mg.add_argument("--force", action="store_true",
+                    help="join streams whose run_ids disagree (clock skew "
+                         "at the stamp second)")
+    mg.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="also export the merged Chrome trace (per-host "
+                         "lanes) to PATH")
 
     sv = sub.add_parser(
         "salvage", help="reconstruct a best-effort manifest from a killed "
@@ -231,6 +252,17 @@ def main(argv: list[str] | None = None) -> int:
                           f"< --fail-below {args.fail_below:g}%",
                           file=sys.stderr)
                     return 1
+            return 0
+
+        if args.cmd == "merge":
+            streams = mrg.resolve_streams(args.streams)
+            out = mrg.merge_file(streams, args.out, force=args.force)
+            doc = load_manifest(out)  # a merge that fails validation is a bug
+            print(out)
+            if args.trace_out:
+                _write(json.dumps(rpt.chrome_trace(doc), indent=1),
+                       args.trace_out)
+            print(rpt.summarize(doc), file=sys.stderr)
             return 0
 
         if args.cmd == "salvage":

@@ -70,6 +70,29 @@ class _NullSpan:
 NULL_SPAN = _NullSpan()
 
 
+def _host_identity() -> tuple[int, int]:
+    """``(host_index, host_count)`` for per-host artifact suffixing.
+
+    ``CRIMP_TPU_OBS_HOST`` overrides (for launchers that co-locate
+    processes on one obs dir without ``jax.distributed`` — which is also
+    the heartbeat-sidecar collision fix); its host COUNT is only the
+    lower bound ``max(2, idx + 1)``, enough to engage the suffix. Unset,
+    identity comes from ``parallel/multihost.process_identity()`` — but
+    only when jax is already imported; obs never drags jax in.
+    """
+    idx = knobs.env_nonneg_int("CRIMP_TPU_OBS_HOST")
+    if idx is not None:
+        return idx, max(2, idx + 1)
+    if "jax" not in sys.modules:
+        return 0, 1
+    try:
+        from crimp_tpu.parallel.multihost import process_identity
+
+        return process_identity()
+    except Exception:  # noqa: BLE001 — identity is best-effort  # graftlint: disable=GL006 (telemetry guard: a failed identity probe must mean single-host, never a crashed run start)
+        return 0, 1
+
+
 def enabled() -> bool:
     """Whether ``CRIMP_TPU_OBS`` asks for telemetry (malformed raises)."""
     return bool(knobs.env_onoff("CRIMP_TPU_OBS"))
@@ -179,7 +202,18 @@ class RunRecorder:
         self.t0 = time.perf_counter()
         self.t0_unix = time.time()
         stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime(self.t0_unix))
-        self.run_id = f"{self.name}-{stamp}-p{os.getpid()}-r{seq}"
+        self.host, self.hosts = _host_identity()
+        if self.hosts > 1:
+            # multi-host: the run_id must be HOST-INVARIANT so `obs merge`
+            # can join the per-host streams — pid would differ per host, so
+            # it is dropped. (Second-level clock skew between hosts can
+            # still split the stamp; `obs merge --force` joins anyway.)
+            self.run_id = f"{self.name}-{stamp}-mh-r{seq}"
+        else:
+            self.run_id = f"{self.name}-{stamp}-p{os.getpid()}-r{seq}"
+        # per-host artifact suffix: events/heartbeat/manifest filenames of
+        # co-located processes must never collide on a shared obs dir
+        self.host_tag = f".host{self.host}" if self.hosts > 1 else ""
         self.dir = knobs.env_str("CRIMP_TPU_OBS_DIR", "obs_runs")
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
@@ -199,7 +233,8 @@ class RunRecorder:
         try:
             os.makedirs(self.dir, exist_ok=True)
             if knobs.env_onoff("CRIMP_TPU_OBS_EVENTS") is not False:
-                path = os.path.join(self.dir, self.run_id + ".events.jsonl")
+                path = os.path.join(
+                    self.dir, self.run_id + self.host_tag + ".events.jsonl")
                 self._events = open(path, "a", encoding="utf-8")
         except OSError:
             # Telemetry must never fail a run: a read-only or full obs dir
@@ -210,6 +245,7 @@ class RunRecorder:
         self._emit({"ev": "run_start", "schema": OBS_SCHEMA,
                     "schema_version": OBS_SCHEMA_VERSION,
                     "run_id": self.run_id, "name": self.name,
+                    "host": self.host, "host_count": self.hosts,
                     "t_start_unix": round(self.t0_unix, 3),
                     "knobs": _knob_snapshot(),
                     "attrs": dict(attrs)})
@@ -255,6 +291,8 @@ class RunRecorder:
             "schema_version": OBS_SCHEMA_VERSION,
             "run_id": self.run_id,
             "name": self.name,
+            "host": self.host,
+            "host_count": self.hosts,
             "t_start_unix": round(self.t0_unix, 3),
             "wall_s": self.spans[0]["dur_s"],
             "error": self.error,
@@ -322,7 +360,8 @@ class RunRecorder:
             if self.spans[0]["dur_s"] is None:
                 self.spans[0]["dur_s"] = round(time.perf_counter() - self.t0, 6)
             doc = self.manifest()
-            path = os.path.join(self.dir, self.run_id + ".manifest.json")
+            path = os.path.join(
+                self.dir, self.run_id + self.host_tag + ".manifest.json")
             tmp = path + ".tmp"
             try:
                 with open(tmp, "w", encoding="utf-8") as fh:

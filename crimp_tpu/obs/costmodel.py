@@ -73,13 +73,31 @@ def _platform_peek() -> str:
     return "none|none"
 
 
+def _leaf_devices(leaf) -> int:
+    """Devices a committed array leaf spans (1 for numpy/uncommitted)."""
+    try:
+        ds = getattr(getattr(leaf, "sharding", None), "device_set", ())
+        return len(ds) if ds else 1
+    except TypeError:  # pragma: no cover - exotic sharding objects
+        return 1
+
+
 def _leaf_sig(leaf) -> str:
-    """One fingerprint token per argument leaf: shape+dtype for arrays,
-    repr for plain statics, type name for anything opaque."""
+    """One fingerprint token per argument leaf: shape+dtype (+ sharding
+    spec for multi-device arrays — a sharded dispatch must never alias
+    the unsharded row of the same shape), repr for plain statics,
+    axis-name/size table for meshes, type name for anything opaque."""
     shape = getattr(leaf, "shape", None)
     dtype = getattr(leaf, "dtype", None)
     if shape is not None and dtype is not None:
-        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+        sig = f"{dtype}[{','.join(str(d) for d in shape)}]"
+        if _leaf_devices(leaf) > 1:
+            sh = leaf.sharding
+            sig += f"@{getattr(sh, 'spec', sh)}x{_leaf_devices(leaf)}"
+        return sig
+    axes = getattr(leaf, "axis_names", None)
+    if shape is not None and axes is not None:  # a Mesh (duck-typed)
+        return "mesh[" + ",".join(f"{a}={shape[a]}" for a in axes) + "]"
     if isinstance(leaf, (bool, int, float, complex, str, bytes, type(None))):
         return repr(leaf)
     return type(leaf).__name__
@@ -97,17 +115,29 @@ def _numeric_knob_sig() -> str:
     return ";".join(parts)
 
 
-def fingerprint(name: str, args: tuple, kwargs: dict) -> str:
+def _plan_sig(plan) -> str:
+    """Fingerprint token for a registry sharding plan (duck-typed so this
+    module never imports the registry or jax at module scope)."""
+    if plan is None:
+        return ""
+    mesh = plan.mesh
+    return ("plan:" + plan.rule.kernel + ";"
+            + ",".join(f"{a}={mesh.shape[a]}" for a in mesh.axis_names))
+
+
+def fingerprint(name: str, args: tuple, kwargs: dict, plan=None) -> str:
     """``cost|<platform>|<device_kind>|<kernel>|<sha>`` — the disk-cache key.
 
-    The sha covers every argument leaf's shape/dtype (or static value) plus
-    the set numeric-mode knobs; the readable prefix keeps the shared
-    autotune cache file greppable.
+    The sha covers every argument leaf's shape/dtype/sharding (or static
+    value), the set numeric-mode knobs, and the registry plan's mesh shape
+    when one is given (a 4-device and an 8-device lowering of the same
+    shapes are different per-device programs); the readable prefix keeps
+    the shared autotune cache file greppable.
     """
     import jax
 
     leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
-    body = "|".join([str(treedef), _numeric_knob_sig()]
+    body = "|".join([str(treedef), _numeric_knob_sig(), _plan_sig(plan)]
                     + [_leaf_sig(leaf) for leaf in leaves])
     sha = hashlib.sha1(body.encode()).hexdigest()[:16]
     return f"cost|{_platform_peek()}|{name}|{sha}"
@@ -115,15 +145,26 @@ def fingerprint(name: str, args: tuple, kwargs: dict) -> str:
 
 def _abstractify(x):
     """Array leaves -> ShapeDtypeStruct so lowering never touches buffers
-    (donated streamed-carry arguments included); statics pass through."""
+    (donated streamed-carry arguments included); statics pass through.
+
+    Committed multi-device shardings are PRESERVED on the stand-in — this
+    is what hands the registry's shardings to the AOT lowering, so the
+    compiled form is the per-device GSPMD program and the cost row reads
+    per-device flops/bytes instead of skipping sharded dispatches."""
     import jax
 
     if hasattr(x, "shape") and hasattr(x, "dtype"):
+        if _leaf_devices(x) > 1:
+            try:
+                return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype,
+                                            sharding=x.sharding)
+            except TypeError:  # pragma: no cover - very old jax
+                pass
         return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
     return x
 
 
-def analyze(fn, args: tuple, kwargs: dict) -> dict:
+def analyze(fn, args: tuple, kwargs: dict, plan=None) -> dict:
     """Lower + AOT-compile ``fn`` on abstract stand-ins; extract the row.
 
     The AOT compile lands in the same executable cache the runtime call
@@ -131,16 +172,35 @@ def analyze(fn, args: tuple, kwargs: dict) -> dict:
     retrace, not a recompile. Missing analyses (backend-dependent) leave
     their fields None — a partial row, never an exception out of here
     beyond what :func:`capture` swallows.
+
+    With a registry ``plan`` (parallel/registry.KernelSharding) — or
+    committed multi-device argument shardings — the row additionally
+    carries ``devices``, ``sharded``, ``reduce_axes`` and the estimated
+    per-device ``collective_bytes`` the kernel's psum moves (ring
+    all-reduce model over the plan's reduce axes and output shapes).
     """
     import jax
 
     aargs = jax.tree_util.tree_map(_abstractify, args)
     akwargs = jax.tree_util.tree_map(_abstractify, kwargs)
-    compiled = fn.lower(*aargs, **akwargs).compile()
+    lowered = fn.lower(*aargs, **akwargs)
+    compiled = lowered.compile()
+    devices = max([1] + [_leaf_devices(leaf) for leaf in
+                         jax.tree_util.tree_leaves((aargs, akwargs))])
+    if plan is not None:
+        devices = max(devices, int(plan.device_count()))
     row: dict = {"flops": None, "bytes_accessed": None, "transcendentals": None,
                  "argument_bytes": None, "output_bytes": None,
                  "temp_bytes": None, "peak_bytes": None,
-                 "generated_code_bytes": None}
+                 "generated_code_bytes": None,
+                 "devices": devices, "sharded": devices > 1}
+    if plan is not None:
+        row["reduce_axes"] = list(plan.rule.reduce_axes)
+        try:
+            outs = jax.tree_util.tree_leaves(lowered.out_info)
+            row["collective_bytes"] = float(plan.collective_bytes(outs))
+        except Exception:  # noqa: BLE001 — out_info is jax-version-dependent  # graftlint: disable=GL006 (telemetry guard: collective accounting degrades to None on jax builds without lowered.out_info)
+            row["collective_bytes"] = None
     try:
         ca = compiled.cost_analysis()
     except Exception:  # noqa: BLE001 — backend-dependent analysis  # graftlint: disable=GL006 (telemetry guard: cost_analysis is absent on some PJRT backends; partial rows are the contract)
@@ -177,14 +237,17 @@ def analyze(fn, args: tuple, kwargs: dict) -> dict:
     return row
 
 
-def capture(name: str, fn, *args, **kwargs) -> dict | None:
+def capture(name: str, fn, *args, plan=None, **kwargs) -> dict | None:
     """Record the cost-model row for one jitted call under span name ``name``.
 
     Call sites invoke this right after dispatching ``fn(*args, **kwargs)``
-    with the SAME arguments. Returns the row (also recorded on the active
-    run, keyed so ``obs roofline`` can join it against the span rollup),
-    or None: no active run, capture knob off, or a capture failure — in
-    which case the pipeline proceeds untouched.
+    with the SAME arguments. ``plan`` (keyword-only, never forwarded to
+    ``fn``) is the registry sharding plan of a sharded dispatch —
+    ``parallel/registry.specs_for(...)`` — and turns on per-device and
+    collective-bytes accounting. Returns the row (also recorded on the
+    active run, keyed so ``obs roofline`` can join it against the span
+    rollup), or None: no active run, capture knob off, or a capture
+    failure — in which case the pipeline proceeds untouched.
     """
     rec = obs_core.active()
     if rec is None:
@@ -192,14 +255,14 @@ def capture(name: str, fn, *args, **kwargs) -> dict | None:
     if not cost_capture_on():
         return None
     try:
-        key = fingerprint(name, args, kwargs)
+        key = fingerprint(name, args, kwargs, plan=plan)
         row = _MEM_CACHE.get(key)
         cache = "mem"
         if row is None:
             row = _disk_get(key)
             cache = "disk"
         if row is None:
-            row = analyze(fn, args, kwargs)
+            row = analyze(fn, args, kwargs, plan=plan)
             cache = "miss"
             _disk_put(key, row)
         _MEM_CACHE[key] = row

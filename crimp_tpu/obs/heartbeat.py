@@ -93,7 +93,12 @@ def beat(done: float, total: float | None, label: str | None = None,
         if hb is None:
             hb = rec.hb = {
                 "period": period_s(),
-                "path": os.path.join(rec.dir, rec.run_id + ".heartbeat.json"),
+                # host_tag keeps co-located processes (multi-host jobs, or
+                # CRIMP_TPU_OBS_HOST-tagged launchers) from clobbering each
+                # other's sidecar on a shared obs dir
+                "path": os.path.join(
+                    rec.dir,
+                    rec.run_id + rec.host_tag + ".heartbeat.json"),
                 "last": None,       # perf_counter of the last emission
                 "label": None,      # rate window anchor: label at t_first
                 "t_first": None,
@@ -124,6 +129,7 @@ def beat(done: float, total: float | None, label: str | None = None,
     doc = {
         "run_id": rec.run_id,
         "name": rec.name,
+        "host": rec.host,
         "t_s": round(now - rec.t0, 3),
         "t_unix": round(time.time(), 3),
         "label": label,

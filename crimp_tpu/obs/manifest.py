@@ -72,6 +72,26 @@ def validate_manifest(doc) -> list[str]:
         problems.append(
             f"'degradations' is {type(doc['degradations']).__name__}, "
             "expected list")
+    # optional extensions (multi-host observability; single-host and older
+    # manifests lack them)
+    for field in ("host", "host_count"):
+        if field in doc and not isinstance(doc[field], int):
+            problems.append(
+                f"{field!r} is {type(doc[field]).__name__}, expected int")
+    if "merged" in doc and not isinstance(doc["merged"], bool):
+        problems.append(
+            f"'merged' is {type(doc['merged']).__name__}, expected bool")
+    if "hosts" in doc:
+        hosts = doc["hosts"]
+        if not isinstance(hosts, list):
+            problems.append(
+                f"'hosts' is {type(hosts).__name__}, expected list")
+        else:
+            for i, row in enumerate(hosts):
+                if not isinstance(row, dict):
+                    problems.append(
+                        f"hosts[{i}] is {type(row).__name__}, "
+                        "expected object")
     # optional extension (PR-10 cost-model layer; older manifests lack it)
     if "costmodel" in doc:
         cm = doc["costmodel"]

@@ -209,17 +209,32 @@ def chrome_trace(doc: dict) -> dict:
     """Chrome trace-event JSON (load in Perfetto / chrome://tracing).
 
     Complete events ("ph": "X") with microsecond timestamps relative to
-    run start; obs thread ordinals become trace tids.
+    run start; obs thread ordinals become trace tids. A merged multi-host
+    manifest (``obs merge``) renders one LANE (trace pid) per host —
+    pid = host + 1, each with its own process_name metadata row — so the
+    per-host subtrees sit side by side on the shared run clock; the
+    synthetic run root stays on pid 1 alongside host 0.
     """
+    merged = bool(doc.get("merged"))
     events = [
         {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
          "args": {"name": f"{doc['name']} ({doc['run_id']})"}},
     ]
+    if merged:
+        for hr in doc.get("hosts") or []:
+            h = hr.get("host", 0)
+            if h == 0:
+                continue  # host 0 shares pid 1 with the run root's row
+            events.append({
+                "ph": "M", "name": "process_name", "pid": h + 1, "tid": 0,
+                "args": {"name": f"host{h} · {doc['name']} "
+                                 f"({doc['run_id']})"}})
     for row in doc["spans"]:
         if row.get("dur_s") is None:
             continue
+        pid = (int(row.get("host", 0)) + 1) if merged else 1
         events.append({
-            "ph": "X", "pid": 1, "tid": row["thread"],
+            "ph": "X", "pid": pid, "tid": row["thread"],
             "name": row["name"], "cat": row["kind"],
             "ts": round(row["t0_s"] * 1e6, 1),
             "dur": round(row["dur_s"] * 1e6, 1),
@@ -257,35 +272,63 @@ def _prom_num(val) -> str:
 
 
 def prometheus(doc: dict) -> str:
-    """Prometheus text exposition (format 0.0.4) for one manifest."""
+    """Prometheus text exposition (format 0.0.4) for one manifest.
+
+    Every series carries a ``host`` label: the writing process index for
+    a per-host manifest (0 on single-host runs), or the source host for
+    a merged multi-host document — whose wall/counter/gauge series are
+    emitted once per host from ``hosts[]`` (the aggregate is one PromQL
+    ``sum()``/``max()`` away, and emitting both would double-count) and
+    whose span series follow each span row's ``host`` field.
+    """
     run = _prom_label(doc["run_id"])
+    merged = bool(doc.get("merged")) and isinstance(doc.get("hosts"), list)
+    host0 = doc["host"] if isinstance(doc.get("host"), int) else 0
+    sources = ([(hr.get("host", i), hr) for i, hr in enumerate(doc["hosts"])]
+               if merged else [(host0, doc)])
     lines = [
         "# HELP crimp_tpu_run_wall_seconds total wall time of the run",
         "# TYPE crimp_tpu_run_wall_seconds gauge",
-        f'crimp_tpu_run_wall_seconds{{run="{run}"}} {_prom_num(doc["wall_s"])}',
+    ]
+    for h, src in sources:
+        lines.append(f'crimp_tpu_run_wall_seconds{{run="{run}",host="{h}"}} '
+                     f'{_prom_num(src["wall_s"])}')
+    lines += [
         "# HELP crimp_tpu_counter_total run counters (events folded, ToAs fit, cache hits, ...)",
         "# TYPE crimp_tpu_counter_total counter",
     ]
-    for name, val in sorted((doc.get("counters") or {}).items()):
-        lines.append(
-            f'crimp_tpu_counter_total{{run="{run}",name="{_prom_label(name)}"}} '
-            f'{_prom_num(val)}')
+    for h, src in sources:
+        for name, val in sorted((src.get("counters") or {}).items()):
+            lines.append(
+                f'crimp_tpu_counter_total{{run="{run}",host="{h}",'
+                f'name="{_prom_label(name)}"}} {_prom_num(val)}')
     lines += [
         "# HELP crimp_tpu_gauge run gauges (padding waste, device counts, ...)",
         "# TYPE crimp_tpu_gauge gauge",
     ]
-    for name, val in sorted((doc.get("gauges") or {}).items()):
-        lines.append(
-            f'crimp_tpu_gauge{{run="{run}",name="{_prom_label(name)}"}} '
-            f'{_prom_num(val)}')
+    for h, src in sources:
+        for name, val in sorted((src.get("gauges") or {}).items()):
+            lines.append(
+                f'crimp_tpu_gauge{{run="{run}",host="{h}",'
+                f'name="{_prom_label(name)}"}} {_prom_num(val)}')
     lines += [
         "# HELP crimp_tpu_span_seconds total seconds per span path",
         "# TYPE crimp_tpu_span_seconds gauge",
         "# HELP crimp_tpu_span_count spans recorded per span path",
         "# TYPE crimp_tpu_span_count gauge",
     ]
-    for path, agg in sorted(span_rollup(doc).items()):
-        label = f'run="{run}",path="{_prom_label(path)}"'
-        lines.append(f"crimp_tpu_span_seconds{{{label}}} {_prom_num(agg['sum_s'])}")
+    rollup: dict[tuple[int, str], dict] = {}
+    for path, row in zip(span_paths(doc), doc.get("spans") or []):
+        dur = row.get("dur_s")
+        if dur is None:
+            continue
+        h = int(row.get("host", host0)) if merged else host0
+        agg = rollup.setdefault((h, path), {"sum_s": 0.0, "count": 0})
+        agg["sum_s"] += float(dur)
+        agg["count"] += 1
+    for (h, path), agg in sorted(rollup.items()):
+        label = f'run="{run}",host="{h}",path="{_prom_label(path)}"'
+        lines.append(f"crimp_tpu_span_seconds{{{label}}} "
+                     f"{_prom_num(round(agg['sum_s'], 6))}")
         lines.append(f"crimp_tpu_span_count{{{label}}} {_prom_num(agg['count'])}")
     return "\n".join(lines) + "\n"

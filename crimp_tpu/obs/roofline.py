@@ -30,22 +30,40 @@ from crimp_tpu.obs.manifest import span_paths
 # which is what the MXU kernels hit; the VPU f32 paths sit below it),
 # bytes_per_s = HBM bandwidth. Sources: Google Cloud TPU system
 # architecture pages (per-chip numbers), in table order v2..v6e.
+# ici_bytes_per_s = aggregate per-chip inter-chip-interconnect bandwidth
+# (approximate — the spec sheets quote per-link Gbps and link counts vary
+# by topology slice); it prices the ring all-reduce the sharded kernels'
+# collective_bytes estimate assumes.
 PEAKS: tuple[tuple[str, dict], ...] = (
     ("v6", {"flops": 918e12, "bytes_per_s": 1.64e12,
-            "source": "TPU v6e spec (bf16 dense, HBM 1640 GB/s)"}),
+            "ici_bytes_per_s": 448e9,
+            "source": "TPU v6e spec (bf16 dense, HBM 1640 GB/s, "
+                      "ICI ~448 GB/s approx)"}),
     ("v5p", {"flops": 459e12, "bytes_per_s": 2.765e12,
-             "source": "TPU v5p spec (bf16 dense, HBM 2765 GB/s)"}),
+             "ici_bytes_per_s": 600e9,
+             "source": "TPU v5p spec (bf16 dense, HBM 2765 GB/s, "
+                       "ICI ~600 GB/s approx)"}),
     ("v5", {"flops": 197e12, "bytes_per_s": 8.19e11,
-            "source": "TPU v5e spec (bf16 dense, HBM 819 GB/s)"}),
+            "ici_bytes_per_s": 200e9,
+            "source": "TPU v5e spec (bf16 dense, HBM 819 GB/s, "
+                      "ICI ~200 GB/s approx)"}),
     ("v4", {"flops": 275e12, "bytes_per_s": 1.228e12,
-            "source": "TPU v4 spec (bf16 dense, HBM 1228 GB/s)"}),
+            "ici_bytes_per_s": 300e9,
+            "source": "TPU v4 spec (bf16 dense, HBM 1228 GB/s, "
+                      "ICI ~300 GB/s approx)"}),
     ("v3", {"flops": 123e12, "bytes_per_s": 9.0e11,
-            "source": "TPU v3 spec (bf16 dense, HBM 900 GB/s)"}),
+            "ici_bytes_per_s": 140e9,
+            "source": "TPU v3 spec (bf16 dense, HBM 900 GB/s, "
+                      "ICI ~140 GB/s approx)"}),
     ("v2", {"flops": 45e12, "bytes_per_s": 7.0e11,
-            "source": "TPU v2 spec (bf16 dense, HBM 700 GB/s)"}),
+            "ici_bytes_per_s": 62.5e9,
+            "source": "TPU v2 spec (bf16 dense, HBM 700 GB/s, "
+                      "ICI ~62.5 GB/s approx)"}),
     ("cpu", {"flops": 1e11, "bytes_per_s": 5e10,
+             "ici_bytes_per_s": 1e10,
              "source": "CPU fallback placeholder (order of magnitude: one "
-                       "AVX2-class core + DDR channel)"}),
+                       "AVX2-class core + DDR channel; 'ICI' = shared "
+                       "memory fabric placeholder)"}),
 )
 
 
@@ -89,14 +107,28 @@ def _leaf_rollup(doc: dict) -> dict[str, dict]:
 def analyze(doc: dict) -> dict:
     """The roofline join for one manifest.
 
-    Returns ``{"backend", "device_kind", "peak", "rows", "worst_pct"}``.
-    Each row: kernel name, calls, measured seconds, flops/bytes from the
-    cost model, achieved flops/s + bytes/s, arithmetic intensity
-    (flops/byte), ``pct_of_roof`` (achieved flops over the roofline at
-    that intensity — min(peak_flops, intensity * peak_bandwidth)), and
-    ``bound`` ("compute" / "memory" by the ridge point). Fields degrade
-    to None wherever the manifest is partial (CPU rows without
-    cost_analysis, cost rows without a matching span, no peak entry).
+    Returns ``{"backend", "device_kind", "peak", "rows", "aggregate",
+    "worst_pct", "best_pct"}``. Each row: kernel name, calls, measured
+    seconds, flops/bytes from the cost model, achieved flops/s + bytes/s,
+    arithmetic intensity (flops/byte), ``pct_of_roof`` (achieved flops
+    over the roofline at that intensity — min(peak_flops, intensity *
+    peak_bandwidth)), and ``bound`` ("compute" / "memory" by the ridge
+    point, or "comm" when the collective dominates — see below).
+
+    Sharded rows (cost rows with ``devices > 1``, captured from the
+    GSPMD-partitioned program, so flops/bytes are already PER DEVICE)
+    additionally carry ``devices``, the aggregate achieved rates
+    (``agg_flops_per_s``/``agg_bytes_per_s`` = per-device x devices),
+    ``collective_bytes_per_call`` (the registry's ring all-reduce
+    estimate), and ``comm_vs_roof`` — the ratio of the estimated
+    collective time (collective bytes over ICI bandwidth) to the
+    per-device compute/memory roofline time; above 1.0 the verdict flips
+    to ``bound = "comm"``. When any sharded row exists, ``aggregate``
+    holds the N-device roofline (single-chip peaks x the widest row's
+    device count; per-row pct_of_roof is per-device and is unchanged by
+    that uniform scaling). Fields degrade to None wherever the manifest
+    is partial (CPU rows without cost_analysis, cost rows without a
+    matching span, no peak entry).
     """
     plat = doc.get("platform") or {}
     devices = plat.get("devices") or []
@@ -134,6 +166,24 @@ def analyze(doc: dict) -> dict:
             bound = "compute" if intensity >= ridge else "memory"
             if fps is not None and roof > 0:
                 pct = 100.0 * fps / roof
+        ndev = cost.get("devices")
+        ndev = int(ndev) if isinstance(ndev, (int, float)) and ndev >= 1 else 1
+        coll = cost.get("collective_bytes")
+        coll = float(coll) if isinstance(coll, (int, float)) else None
+        comm_vs_roof = None
+        if ndev > 1 and peak and peak.get("ici_bytes_per_s") \
+                and coll is not None \
+                and isinstance(flops, (int, float)) \
+                and isinstance(nbytes, (int, float)):
+            # per-device, per-call: the time the collective needs on the
+            # interconnect vs the time the compute/memory roofline grants
+            # the kernel body — whichever dominates names the binding
+            # resource
+            t_roof = max(flops / peak["flops"], nbytes / peak["bytes_per_s"])
+            if t_roof > 0:
+                comm_vs_roof = (coll / peak["ici_bytes_per_s"]) / t_roof
+                if comm_vs_roof > 1.0:
+                    bound = "comm"
         rows.append({
             "name": name,
             "calls": calls,
@@ -145,17 +195,34 @@ def analyze(doc: dict) -> dict:
             "intensity": round(intensity, 4) if intensity is not None else None,
             "pct_of_roof": round(pct, 3) if pct is not None else None,
             "bound": bound,
+            "devices": ndev,
+            "agg_flops_per_s": fps * ndev if fps is not None else None,
+            "agg_bytes_per_s": bps * ndev if bps is not None else None,
+            "collective_bytes_per_call": coll,
+            "comm_vs_roof": (round(comm_vs_roof, 3)
+                             if comm_vs_roof is not None else None),
             "peak_bytes": cost.get("peak_bytes"),
             "span": cost.get("span"),
         })
     rows.sort(key=lambda r: -(r["sum_s"] or 0.0))
     pcts = [r["pct_of_roof"] for r in rows if r["pct_of_roof"] is not None]
+    shard_devs = [r["devices"] for r in rows if r["devices"] > 1]
+    aggregate = None
+    if shard_devs and peak:
+        n = max(shard_devs)
+        aggregate = {
+            "devices": n,
+            "flops": peak["flops"] * n,
+            "bytes_per_s": peak["bytes_per_s"] * n,
+            "ici_bytes_per_s": peak.get("ici_bytes_per_s"),
+        }
     return {
         "run_id": doc.get("run_id"),
         "backend": plat.get("backend"),
         "device_kind": kind,
         "peak": peak,
         "rows": rows,
+        "aggregate": aggregate,
         "worst_pct": min(pcts) if pcts else None,
         "best_pct": max(pcts) if pcts else None,
     }
@@ -193,7 +260,8 @@ def render(analysis: dict, top: int = 20) -> str:
                      "off, or no instrumented kernels ran)")
         return "\n".join(lines)
     lines.append(f"{'kernel':<22} {'calls':>5} {'time':>9} {'flop/call':>10} "
-                 f"{'achieved':>12} {'intens':>7} {'%roof':>6}  bound")
+                 f"{'achieved':>12} {'intens':>7} {'%roof':>6} {'dev':>3}"
+                 "  bound")
     for r in rows[:top]:
         dur = f"{r['sum_s']:.3f}s" if r["sum_s"] is not None else "?"
         pct = f"{r['pct_of_roof']:.1f}" if r["pct_of_roof"] is not None else "?"
@@ -202,7 +270,25 @@ def render(analysis: dict, top: int = 20) -> str:
             f"{_eng(r['flops_per_call'], 'F'):>10} "
             f"{_eng(r['flops_per_s'], 'F/s'):>12} "
             f"{r['intensity'] if r['intensity'] is not None else '?':>7} "
-            f"{pct:>6}  {r['bound'] or '?'}")
+            f"{pct:>6} {r.get('devices', 1):>3}  {r['bound'] or '?'}")
+    agg = analysis.get("aggregate")
+    if agg:
+        lines.append(
+            f"sharded  {agg['devices']}-device aggregate roof: "
+            f"{_eng(agg['flops'], 'FLOP/s')}  "
+            f"{_eng(agg['bytes_per_s'], 'B/s')}  "
+            f"ici {_eng(agg.get('ici_bytes_per_s'), 'B/s')}")
+        for r in rows[:top]:
+            if r.get("devices", 1) <= 1:
+                continue
+            ratio = r.get("comm_vs_roof")
+            lines.append(
+                f"  {r['name']}: x{r['devices']}  "
+                f"agg {_eng(r['agg_flops_per_s'], 'F/s')}  "
+                f"collective {_eng(r['collective_bytes_per_call'], 'B')}/call"
+                f"  t_comm/t_roof "
+                f"{ratio if ratio is not None else '?'}"
+                f"  {(r['bound'] or '?') + '-bound'}")
     worst = analysis.get("worst_pct")
     if worst is not None:
         lines.append(f"worst measured kernel: {worst:.2f}% of roof")

@@ -91,6 +91,7 @@ def salvage(events_path: str) -> dict:
     }]
     counters: dict[str, float] = {}
     gauges: dict[str, float] = {}
+    costmodel: dict[str, dict] = {}
     numeric_mode = None
     error = None
     backend = None
@@ -131,6 +132,10 @@ def salvage(events_path: str) -> dict:
             k, v = ev.get("k"), ev.get("v")
             if isinstance(k, str) and isinstance(v, (int, float)):
                 gauges[k] = v
+        elif kind == "cost":
+            k, row = ev.get("k"), ev.get("row")
+            if isinstance(k, str) and isinstance(row, dict):
+                costmodel[k] = row  # last capture wins, like record_cost
         elif kind == "numeric_mode":
             if isinstance(ev.get("mode"), dict):
                 numeric_mode = ev["mode"]
@@ -154,11 +159,17 @@ def salvage(events_path: str) -> dict:
         p = row.get("parent")
         if not isinstance(p, int) or not (0 <= p < i):
             row["parent"] = 0
+    doc_host = start.get("host")
     return {
         "schema": start.get("schema") or OBS_SCHEMA,
         "schema_version": start.get("schema_version") or OBS_SCHEMA_VERSION,
         "run_id": run_id,
         "name": name,
+        # host identity survives salvage so `obs merge` can lane the
+        # reconstruction like a finalized per-host manifest
+        "host": doc_host if isinstance(doc_host, int) else 0,
+        "host_count": start.get("host_count")
+        if isinstance(start.get("host_count"), int) else 1,
         "t_start_unix": start.get("t_start_unix") or 0.0,
         "wall_s": spans[0]["dur_s"],
         "error": error,
@@ -169,6 +180,7 @@ def salvage(events_path: str) -> dict:
         "compile": None,
         "counters": counters,
         "gauges": gauges,
+        "costmodel": costmodel,
         "spans": spans,
         "salvaged": not ended,
         "heartbeat": heartbeat,

@@ -277,18 +277,15 @@ def fold_sources(timing_models, seg_times_list, t_ref_list=None):
         for r, (_, delta, anchor_idx, _, _) in enumerate(part):
             delta_pad[r, : delta.size] = delta
             idx_pad[r, : anchor_idx.size] = anchor_idx
-        sm, delta_dev, idx_dev, n_real = _maybe_shard_sources(
+        sm, delta_dev, idx_dev, n_real, plan = _maybe_shard_sources(
             sm, delta_pad, idx_pad
         )
         rows = np.asarray(stacked_fold(sm, delta_dev, idx_dev))[:n_real]
-        # cost capture only for unsharded dispatches: abstract stand-ins
-        # lose shardings, so a sharded chunk would cost-model (and
-        # compile) a variant that never ran
-        shards = getattr(getattr(delta_dev, "sharding", None),
-                         "device_set", ())
-        if len(shards) <= 1:
-            costmodel.capture("stacked_fold", stacked_fold,
-                              sm, delta_dev, idx_dev)
+        # sharded chunks cost-model too: the committed shardings survive
+        # abstraction (obs/costmodel._abstractify), so the AOT lowering is
+        # the same per-device program the dispatch above just ran
+        costmodel.capture("stacked_fold", stacked_fold,
+                          sm, delta_dev, idx_dev, plan=plan)
         folded_rows.extend(rows)
     phase_lists = []
     t_refs = []
@@ -304,27 +301,32 @@ def _maybe_shard_sources(sm: StackedAnchoredModel, delta: np.ndarray,
                          idx: np.ndarray):
     """Shard the source axis across devices when it pays (pure data
     parallelism; bitwise identical to the unsharded dispatch). Returns
-    possibly-padded (sm, delta, idx) plus the real row count."""
+    possibly-padded (sm, delta, idx), the real row count, and the registry
+    sharding plan (None when the dispatch stays on one device)."""
     from crimp_tpu.parallel import mesh as pmesh
+    from crimp_tpu.parallel import registry
 
     n = sm.n_source
     if not pmesh.sharding_enabled():
-        return sm, jnp.asarray(delta), jnp.asarray(idx), n
+        return sm, jnp.asarray(delta), jnp.asarray(idx), n, None
     n_devices = len(jax.devices())
     if n_devices < 2 or n < n_devices:
-        return sm, jnp.asarray(delta), jnp.asarray(idx), n
+        return sm, jnp.asarray(delta), jnp.asarray(idx), n, None
     smesh = pmesh.source_mesh()
+    plan = registry.specs_for("stacked_fold", smesh)
     pad = pmesh.pad_batch_for_mesh(n, smesh, axis_name=pmesh.SOURCE_AXIS)
     if pad:
         sm = concat_stacked(sm, inert_rows(sm, pad))
         delta = np.concatenate([delta, np.zeros((pad,) + delta.shape[1:])])
         idx = np.concatenate([idx, np.zeros((pad,) + idx.shape[1:], idx.dtype)])
+
+    def put(name, arr):
+        return jax.device_put(np.asarray(arr), plan.named(name))
+
     sm = StackedAnchoredModel(
-        **{name: pmesh.shard_sources(np.asarray(getattr(sm, name)), smesh)
-           for name in _FIELDS}
+        **{name: put(name, getattr(sm, name)) for name in _FIELDS}
     )
-    return (sm, pmesh.shard_sources(delta, smesh),
-            pmesh.shard_sources(idx, smesh), n)
+    return sm, put("delta", delta), put("idx", idx), n, plan
 
 
 # ---------------------------------------------------------------------------

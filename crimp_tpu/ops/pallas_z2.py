@@ -40,6 +40,12 @@ TRIAL_TILE = 256
 EVENT_CHUNK = 1024
 TILE_CHUNK = 32  # trial tiles whose f64 base rows are materialized at once
 
+# jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams (~0.4.34);
+# resolve whichever this build ships so the kernel compiles on both sides
+# of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or getattr(pltpu, "TPUCompilerParams")
+
 
 def pallas_minimal_probe() -> float:
     """Compile and run the smallest useful Mosaic kernel (y = x + 1 on one
@@ -120,7 +126,7 @@ def _tile_chunk_sums(
         out_shape=(out_shape, out_shape),
         # trial tiles are independent (parallel); the event axis revisits
         # the same output block (sequential accumulation -> arbitrary)
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=interpret,

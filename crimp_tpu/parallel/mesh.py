@@ -42,7 +42,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 try:  # jax >= 0.6 exposes shard_map at top level
     from jax import shard_map
@@ -50,6 +50,20 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 from crimp_tpu import knobs, obs
+from crimp_tpu.obs import costmodel
+
+# Every PartitionSpec this module dispatches with comes from the
+# declarative registry (GL007); the axis names live there too and are
+# re-exported here for the call sites that grew up importing them from
+# mesh.
+from crimp_tpu.parallel.registry import (
+    EVENT_AXIS,
+    SEGMENT_AXIS,
+    SOURCE_AXIS,
+    TRIAL_AXIS,
+    leading_axis_sharding,
+    specs_for,
+)
 
 from crimp_tpu.ops.search import (
     DEFAULT_EVENT_BLOCK,
@@ -67,11 +81,6 @@ from crimp_tpu.ops.search import (
     uniform_grid,
     z2_from_sums,
 )
-
-EVENT_AXIS = "events"
-TRIAL_AXIS = "trials"
-SEGMENT_AXIS = "segments"
-SOURCE_AXIS = "sources"
 
 
 def sharding_enabled() -> bool:
@@ -143,9 +152,8 @@ def shard_sources(array, mesh: Mesh):
     collectives and no reduction-order change — bitwise identical to the
     single-device dispatch (the same contract shard_segments gives the
     ToA-segment fits)."""
-    spec = [None] * np.ndim(array)
-    spec[0] = SOURCE_AXIS
-    return jax.device_put(np.asarray(array), NamedSharding(mesh, P(*spec)))
+    return jax.device_put(np.asarray(array),
+                          leading_axis_sharding(mesh, SOURCE_AXIS))
 
 
 def _pad_to(x: np.ndarray, multiple: int, fill=0.0):
@@ -201,11 +209,12 @@ def _sharded_sums_general(
         c_all, s_all = jax.lax.map(one_fd, fd_all)
         return jax.lax.psum(c_all, EVENT_AXIS), jax.lax.psum(s_all, EVENT_AXIS)
 
+    plan = specs_for("sharded_sums_general", mesh)
     return shard_map(
         kernel,
         mesh=mesh,
-        in_specs=(P(EVENT_AXIS), P(EVENT_AXIS), P(TRIAL_AXIS), P(None)),
-        out_specs=(P(None, None, TRIAL_AXIS), P(None, None, TRIAL_AXIS)),
+        in_specs=plan.in_specs("times", "weights", "freqs", "fdots"),
+        out_specs=plan.out_specs,
     )(times, weights, freqs, fdots)
 
 
@@ -270,11 +279,12 @@ def _sharded_sums_grid(
             )
         return jax.lax.psum(c_all, EVENT_AXIS), jax.lax.psum(s_all, EVENT_AXIS)
 
+    plan = specs_for("sharded_sums_grid", mesh)
     return shard_map(
         kernel,
         mesh=mesh,
-        in_specs=(P(EVENT_AXIS), P(EVENT_AXIS), P(None)),
-        out_specs=(P(None, None, TRIAL_AXIS), P(None, None, TRIAL_AXIS)),
+        in_specs=plan.in_specs("times", "weights", "fdots"),
+        out_specs=plan.out_specs,
     )(times, weights, fdots)
 
 
@@ -318,22 +328,27 @@ def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath,
         # to small inputs exactly as it always shrank the static default.
         g_eb, g_tb = resolve_blocks("grid_mxu" if mx else "grid",
                                     ev_per_shard, tr_per_shard, poly)
-        c, s = _sharded_sums_grid(
-            jnp.asarray(t_pad), jnp.asarray(w_pad), f0, df, n_freq_pad, fd, nharm, mesh,
-            event_block=_fit_block(g_eb, ev_per_shard),
-            trial_block=_fit_block(g_tb, tr_per_shard),
-            poly=poly, mxu=mx, reseed=rs, mxu_bf16=b16,
-        )
+        gargs = (jnp.asarray(t_pad), jnp.asarray(w_pad), f0, df, n_freq_pad,
+                 fd, nharm, mesh)
+        gkw = dict(event_block=_fit_block(g_eb, ev_per_shard),
+                   trial_block=_fit_block(g_tb, tr_per_shard),
+                   poly=poly, mxu=mx, reseed=rs, mxu_bf16=b16)
+        c, s = _sharded_sums_grid(*gargs, **gkw)
+        costmodel.capture("sharded_sums_grid", _sharded_sums_grid, *gargs,
+                          plan=specs_for("sharded_sums_grid", mesh), **gkw)
     else:
         f_pad, _ = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
         d_eb, d_tb = resolve_blocks("general", ev_per_shard, tr_per_shard, poly)
-        c, s = _sharded_sums_general(
-            jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad), fd,
-            nharm, mesh, trig_dtype=trig_dtype,
-            event_block=_fit_block(d_eb, ev_per_shard),
-            trial_block=_fit_block(d_tb, tr_per_shard),
-            poly=poly,
-        )
+        gargs = (jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad),
+                 fd, nharm, mesh)
+        gkw = dict(trig_dtype=trig_dtype,
+                   event_block=_fit_block(d_eb, ev_per_shard),
+                   trial_block=_fit_block(d_tb, tr_per_shard),
+                   poly=poly)
+        c, s = _sharded_sums_general(*gargs, **gkw)
+        costmodel.capture("sharded_sums_general", _sharded_sums_general,
+                          *gargs,
+                          plan=specs_for("sharded_sums_general", mesh), **gkw)
     return c[:, :, :n_freq], s[:, :, :n_freq]
 
 
@@ -418,13 +433,20 @@ def delta_refold_sharded(tm, t_ref_mjd, folded, delta, anchor_idx, dp,
                                  wave_in_f0=wave_in_f0)
         return deltafold.refold(ph_shard, b, dp_rep)
 
-    out = shard_map(
+    plan = specs_for("delta_refold", mesh)
+    sharded = shard_map(
         kernel,
         mesh=mesh,
-        in_specs=(P(), P(EVENT_AXIS), P(EVENT_AXIS), P(EVENT_AXIS), P()),
-        out_specs=P(EVENT_AXIS),
-    )(spec, jnp.asarray(folded_p), jnp.asarray(delta_p), jnp.asarray(idx_p),
-      jnp.asarray(np.asarray(dp, dtype=np.float64)))
+        in_specs=plan.in_specs("spec", "folded", "delta", "anchor_idx", "dp"),
+        out_specs=plan.out_specs,
+    )
+    args = (spec, jnp.asarray(folded_p), jnp.asarray(delta_p),
+            jnp.asarray(idx_p), jnp.asarray(np.asarray(dp, dtype=np.float64)))
+    out = sharded(*args)
+    # the dispatch itself is eager; a jit wrapper exists only so cost
+    # capture can AOT-lower the identical sharded program
+    costmodel.capture("delta_refold_sharded", jax.jit(sharded), *args,
+                      plan=plan)
     return np.asarray(out)[:n]
 
 
@@ -440,9 +462,7 @@ def shard_segments(array: np.ndarray, mesh: Mesh, axis_name: str | None = None):
     mesh."""
     if axis_name is None:
         axis_name = SEGMENT_AXIS if SEGMENT_AXIS in mesh.axis_names else TRIAL_AXIS
-    spec = [None] * np.ndim(array)
-    spec[0] = axis_name
-    return jax.device_put(array, NamedSharding(mesh, P(*spec)))
+    return jax.device_put(array, leading_axis_sharding(mesh, axis_name))
 
 
 def pad_batch_for_mesh(n: int, mesh: Mesh, axis_name: str = SEGMENT_AXIS) -> int:

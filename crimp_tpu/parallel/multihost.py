@@ -51,6 +51,30 @@ def initialize(coordinator_address: str | None = None,
     )
 
 
+def process_identity() -> tuple[int, int]:
+    """``(process_index, process_count)`` of this host in the job.
+
+    The obs layer keys per-host artifact suffixes off this (each host's
+    event stream / heartbeat / manifest gets a ``host<k>`` suffix, later
+    joined by ``obs merge``). Never initializes a backend: if no backend
+    is live yet — the same peek contract as ``obs.core`` — this reports
+    the single-host identity ``(0, 1)`` rather than forcing bring-up.
+    """
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0, 1
+    try:
+        from jax._src import xla_bridge
+
+        if not (getattr(xla_bridge, "_backends", None) or {}):
+            return 0, 1
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:  # graftlint: disable=GL006 (identity is best-effort telemetry input; a failed peek must mean single-host, never a crash)
+        return 0, 1
+
+
 def topology_mesh(devices=None, event_parallel: int | None = None) -> Mesh:
     """A 2-D (events x trials) mesh with ICI-topology-aware device order.
 
@@ -140,6 +164,7 @@ def auto_global_mesh(min_devices: int = 2) -> Mesh | None:
 
 __all__ = [
     "initialize",
+    "process_identity",
     "topology_mesh",
     "hybrid_mesh",
     "auto_global_mesh",

@@ -1,0 +1,222 @@
+"""Declarative sharding registry: kernel/param regex -> PartitionSpec rules.
+
+``parallel/mesh.py`` grew five bespoke sharded twins, each hand-writing its
+``in_specs``/``out_specs`` at the ``shard_map`` call site — which meant the
+sharding of a kernel lived nowhere the rest of the system could see it. The
+observability stack paid for that directly: cost capture skipped every
+sharded dispatch because a ``ShapeDtypeStruct`` stand-in loses shardings,
+so exactly the multi-device paths had no roofline rows (ROADMAP item 4).
+
+This module is the single source of truth instead: a table of
+kernel-name-regex rules, each mapping param-name regexes to
+``PartitionSpec``s (first match wins, scalars replicate by default), plus
+the kernel's output specs and the mesh axes its internal ``psum`` reduces
+over. ``specs_for(kernel, mesh)`` binds a rule to a concrete mesh and
+hands back everything a call site or an observer needs:
+
+- ``shard_map`` call sites ask for ``.in_specs(...)`` / ``.out_specs``;
+- ``jax.device_put`` call sites ask for ``.named(param, ndim)`` (or the
+  ``leading_axis_sharding`` helper for the leading-axis data-parallel
+  placements);
+- ``obs/costmodel.py`` asks for ``.device_count()`` and
+  ``.collective_bytes(out_info)`` so the AOT-lowered per-device program
+  gets per-device FLOPs/bytes AND an estimate of the bytes its collectives
+  move over ICI.
+
+Migrating a kernel onto the registry is bitwise-neutral by construction:
+the specs are the SAME objects the call sites used to write inline — the
+table changes where they are written down, not what the partitioner sees.
+The 8-device parity pins in tests/test_parallel.py assert exactly that.
+
+graftlint GL007 enforces the discipline: hand-written ``PartitionSpec(...)``
+anywhere in ``crimp_tpu/`` outside this module needs a waiver reason.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Mesh axis names — defined HERE (the registry is the bottom of the
+# parallel/ import graph); mesh.py re-exports them for compatibility.
+EVENT_AXIS = "events"
+TRIAL_AXIS = "trials"
+SEGMENT_AXIS = "segments"
+SOURCE_AXIS = "sources"
+
+REPLICATED = P()
+
+
+@dataclass(frozen=True)
+class KernelRule:
+    """One registry row: which kernels it covers and how they shard.
+
+    ``kernel`` and the param patterns are ``re.search`` regexes.
+    ``params`` maps param-name patterns to in-specs (first match wins);
+    ``outs`` is the output-spec tuple in output order; ``reduce_axes``
+    names the mesh axes the kernel psum-reduces over internally (the
+    collective the cost model accounts for)."""
+
+    kernel: str
+    params: tuple[tuple[str, P], ...]
+    outs: tuple[P, ...]
+    reduce_axes: tuple[str, ...] = ()
+    note: str = ""
+
+
+RULES: tuple[KernelRule, ...] = (
+    KernelRule(
+        kernel=r"^sharded_sums_general$",
+        params=(
+            (r"^(times|weights)$", P(EVENT_AXIS)),
+            (r"^freqs$", P(TRIAL_AXIS)),
+            (r"^fdots$", P(None)),
+        ),
+        outs=(P(None, None, TRIAL_AXIS), P(None, None, TRIAL_AXIS)),
+        reduce_axes=(EVENT_AXIS,),
+        note="arbitrary-grid trig sums: events psum-reduced, freqs "
+             "embarrassingly parallel over the trial axis",
+    ),
+    KernelRule(
+        kernel=r"^sharded_sums_grid$",
+        params=(
+            (r"^(times|weights)$", P(EVENT_AXIS)),
+            (r"^fdots$", P(None)),
+        ),
+        outs=(P(None, None, TRIAL_AXIS), P(None, None, TRIAL_AXIS)),
+        reduce_axes=(EVENT_AXIS,),
+        note="uniform-grid fast path: frequency range is derived from "
+             "axis_index, so only events/weights are array inputs",
+    ),
+    KernelRule(
+        kernel=r"^delta_refold",
+        params=(
+            (r"^(folded|delta|anchor_idx)$", P(EVENT_AXIS)),
+            (r"^(spec|dp)$", REPLICATED),
+        ),
+        outs=(P(EVENT_AXIS),),
+        reduce_axes=(),
+        note="per-event basis build + refold matmul; no collective (each "
+             "row's dot runs over the replicated dp)",
+    ),
+    KernelRule(
+        kernel=r"^(stacked_fold|toa_fit_batch_multi|source_batch)",
+        params=((r".*", P(SOURCE_AXIS)),),
+        outs=(P(SOURCE_AXIS),),
+        reduce_axes=(),
+        note="multisource engine: pure data parallelism over the stacked "
+             "source axis; leading-axis sharding, no collectives",
+    ),
+    KernelRule(
+        kernel=r"^segment_batch",
+        params=((r".*", P(SEGMENT_AXIS)),),
+        outs=(P(SEGMENT_AXIS),),
+        reduce_axes=(),
+        note="segment-batched ToA fits: data parallel over segments",
+    ),
+)
+
+
+def _mesh_axis_size(mesh: Mesh, axis) -> int:
+    """Size of one PartitionSpec entry's mesh extent (str or tuple of str)."""
+    if axis is None:
+        return 1
+    if isinstance(axis, str):
+        return int(mesh.shape[axis])
+    return int(math.prod(int(mesh.shape[a]) for a in axis))
+
+
+class KernelSharding:
+    """A :class:`KernelRule` bound to a concrete mesh — the lookup result."""
+
+    def __init__(self, rule: KernelRule, mesh: Mesh):
+        self.rule = rule
+        self.mesh = mesh
+
+    # -- specs for dispatch --------------------------------------------------
+
+    def spec(self, param: str, leaf=None) -> P:
+        """The in-spec for one parameter (first regex match wins).
+
+        With ``leaf`` given, 0-d leaves fall back to replication — the
+        replicate-scalars default — before an unmatched name raises."""
+        for pat, sp in self.rule.params:
+            if re.search(pat, param):
+                return sp
+        if leaf is not None and np.ndim(leaf) == 0:
+            return REPLICATED
+        raise KeyError(
+            f"sharding registry: kernel rule {self.rule.kernel!r} has no "
+            f"spec for param {param!r} (add a row or pass a scalar leaf)")
+
+    def in_specs(self, *names: str) -> tuple[P, ...]:
+        return tuple(self.spec(n) for n in names)
+
+    @property
+    def out_specs(self):
+        """Output specs shaped for ``shard_map``: a lone spec for a
+        single-output kernel, the tuple otherwise."""
+        outs = self.rule.outs
+        return outs[0] if len(outs) == 1 else outs
+
+    def named(self, param: str, leaf=None) -> NamedSharding:
+        """The in-spec as a ``NamedSharding`` (for ``jax.device_put`` /
+        ``ShapeDtypeStruct`` placement on this mesh)."""
+        return NamedSharding(self.mesh, self.spec(param, leaf))
+
+    # -- accounting for the cost model ---------------------------------------
+
+    def device_count(self) -> int:
+        return int(math.prod(int(s) for s in self.mesh.shape.values()))
+
+    def reduce_size(self) -> int:
+        """Devices participating in the kernel's psum (1 = no collective)."""
+        return int(math.prod(
+            int(self.mesh.shape[a]) for a in self.rule.reduce_axes)) or 1
+
+    def collective_bytes(self, out_info) -> float:
+        """Estimated PER-DEVICE bytes the kernel's psum moves over ICI.
+
+        Ring all-reduce over ``k`` devices moves ``2*(k-1)/k * B`` bytes
+        per device, where ``B`` is the per-shard reduced-buffer size —
+        each global output's bytes divided by the mesh extent of its
+        sharded out-spec axes. ``out_info`` is an iterable of objects with
+        ``.shape``/``.dtype`` (ShapeDtypeStructs or arrays), one per
+        kernel output, in ``outs`` order. 0.0 when the rule reduces over
+        nothing or one device."""
+        k = self.reduce_size()
+        if k <= 1:
+            return 0.0
+        total = 0.0
+        for sds, out_spec in zip(out_info, self.rule.outs):
+            nbytes = (math.prod(int(d) for d in sds.shape)
+                      * np.dtype(sds.dtype).itemsize)
+            shards = math.prod(_mesh_axis_size(self.mesh, ax)
+                               for ax in out_spec) or 1
+            total += nbytes / shards
+        return 2.0 * (k - 1) / k * total
+
+
+def specs_for(kernel: str, mesh: Mesh) -> KernelSharding:
+    """The registry lookup: the first rule whose regex matches ``kernel``,
+    bound to ``mesh``. Raises ``KeyError`` for unregistered kernels — a
+    sharded dispatch with no registry row is a bug, not a default."""
+    for rule in RULES:
+        if re.search(rule.kernel, kernel):
+            return KernelSharding(rule, mesh)
+    raise KeyError(
+        f"sharding registry: no rule matches kernel {kernel!r}; add a "
+        f"KernelRule to crimp_tpu/parallel/registry.py")
+
+
+def leading_axis_sharding(mesh: Mesh, axis_name: str) -> NamedSharding:
+    """Leading-axis data-parallel placement: ``P(axis_name)`` on ``mesh``.
+
+    A spec shorter than the array rank replicates the trailing dims, so
+    this is exactly the ``P(axis, None, ..., None)`` the data-parallel
+    call sites used to build by hand — for any rank."""
+    return NamedSharding(mesh, P(axis_name))

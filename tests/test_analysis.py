@@ -31,7 +31,9 @@ def run_tree(tmp_path, files, *, rules=None, registry=None,
              tools_md_text="", numeric_keys=("fake_mode",),
              gl004_allowlist=("pkg/anchor.py",),
              gl005_modules=("pkg/parallel/",),
-             gl006_modules=("pkg/",)):
+             gl006_modules=("pkg/",),
+             gl007_modules=("pkg/",),
+             gl007_registry="pkg/parallel/registry.py"):
     """Write a fixture tree and run the analyzer over it."""
     for rel, text in files.items():
         p = tmp_path / rel
@@ -52,6 +54,8 @@ def run_tree(tmp_path, files, *, rules=None, registry=None,
         gl004_allowlist=gl004_allowlist,
         gl005_modules=gl005_modules,
         gl006_modules=gl006_modules,
+        gl007_modules=gl007_modules,
+        gl007_registry=gl007_registry,
     )
     return engine.run(cfg)
 
@@ -588,6 +592,67 @@ class TestGL006:
                     pass
         """}, rules=("GL006",))
         assert rep.unwaived == []
+
+
+# ---------------------------------------------------------------------------
+# GL007 sharding-registry discipline
+# ---------------------------------------------------------------------------
+
+
+class TestGL007:
+    def test_aliased_partitionspec_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/parallel/mesh.py": """
+            from jax.sharding import PartitionSpec as P
+
+            def dispatch():
+                return P("events", None)
+        """}, rules=("GL007",))
+        assert len(rep.unwaived) == 1
+        assert rep.unwaived[0].rule == "GL007"
+
+    def test_dotted_partitionspec_fires(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/ops/fold.py": """
+            import jax
+
+            def dispatch():
+                return jax.sharding.PartitionSpec("events")
+        """}, rules=("GL007",))
+        assert len(rep.unwaived) == 1
+
+    def test_registry_module_is_sanctioned(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/parallel/registry.py": """
+            from jax.sharding import PartitionSpec as P
+
+            RULE = P("events")
+        """}, rules=("GL007",))
+        assert rep.unwaived == []
+
+    def test_outside_scoped_modules_is_clean(self, tmp_path):
+        rep = run_tree(tmp_path, {"scripts/tool.py": """
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("events")
+        """}, rules=("GL007",))
+        assert rep.unwaived == []
+
+    def test_unrelated_name_p_is_clean(self, tmp_path):
+        # a bare P() only counts when the file imported PartitionSpec as P
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            def P(x):
+                return x
+
+            Y = P(3)
+        """}, rules=("GL007",))
+        assert rep.unwaived == []
+
+    def test_waived_with_reason(self, tmp_path):
+        rep = run_tree(tmp_path, {"pkg/ops/fold.py": """
+            from jax.sharding import PartitionSpec as P
+
+            SPEC = P("events")  # graftlint: disable=GL007 (fixture: spec is kernel-private, not a dispatch rule)
+        """}, rules=("GL007",))
+        assert rep.unwaived == []
+        assert any(f.rule == "GL007" and f.waived for f in rep.findings)
 
 
 # ---------------------------------------------------------------------------

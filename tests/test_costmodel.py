@@ -142,7 +142,7 @@ class TestCaptureRows:
         calls = []
         real = costmodel.analyze
         monkeypatch.setattr(costmodel, "analyze",
-                            lambda *a: calls.append(1) or real(*a))
+                            lambda *a, **k: calls.append(1) or real(*a, **k))
         with obs.run("r"):
             first = costmodel.capture("k", fn, x)
             second = costmodel.capture("k", fn, x)
@@ -270,6 +270,61 @@ class TestRoofline:
         assert "fold" in text and "%roof" in text and "memory" in text
         empty = roofline.render(roofline.analyze(_doc({}, [])))
         assert "no cost-model rows" in empty
+
+    def test_sharded_row_comm_verdict_and_aggregate(self):
+        """A sharded cost row (per-device flops/bytes from the GSPMD
+        program) gains aggregate rates and the comm-vs-compute verdict:
+        on v4 the 3e9 B/call collective needs 10 ms of ICI while the
+        per-device roofline grants the body ~8.1 ms -> comm-bound."""
+        doc = _doc(
+            {"shard": {"flops": 1e12, "bytes_accessed": 1e10, "span": None,
+                       "devices": 8, "sharded": True,
+                       "reduce_axes": ["events"], "collective_bytes": 3e9},
+             "local": {"flops": 1e12, "bytes_accessed": 1e10, "span": None}},
+            [_span("run", 10.0, kind="run"), _span("shard", 2.0, parent=0),
+             _span("local", 2.0, parent=0)])
+        out = roofline.analyze(doc)
+        by = {r["name"]: r for r in out["rows"]}
+        sh = by["shard"]
+        assert sh["devices"] == 8
+        t_roof = max(1e12 / 275e12, 1e10 / 1.228e12)
+        assert sh["comm_vs_roof"] == pytest.approx((3e9 / 300e9) / t_roof,
+                                                   abs=1e-3)
+        assert sh["comm_vs_roof"] > 1.0 and sh["bound"] == "comm"
+        assert sh["agg_flops_per_s"] == pytest.approx(8 * sh["flops_per_s"])
+        assert sh["collective_bytes_per_call"] == 3e9
+        assert by["local"]["devices"] == 1
+        assert by["local"]["bound"] == "memory"  # intensity 100 < v4 ridge
+        agg = out["aggregate"]
+        assert agg["devices"] == 8
+        assert agg["flops"] == pytest.approx(8 * 275e12)
+        assert agg["bytes_per_s"] == pytest.approx(8 * 1.228e12)
+        assert agg["ici_bytes_per_s"] == pytest.approx(300e9)
+
+    def test_sharded_row_below_comm_threshold_keeps_verdict(self):
+        doc = _doc(
+            {"shard": {"flops": 1e12, "bytes_accessed": 1e10, "span": None,
+                       "devices": 8, "collective_bytes": 1e9}},
+            [_span("run", 10.0, kind="run"), _span("shard", 2.0, parent=0)])
+        (row,) = roofline.analyze(doc)["rows"]
+        assert row["comm_vs_roof"] is not None and row["comm_vs_roof"] < 1.0
+        assert row["bound"] == "memory"
+
+    def test_unsharded_doc_has_no_aggregate(self):
+        doc = _doc(
+            {"fold": {"flops": 1e12, "bytes_accessed": 2e12, "span": None}},
+            [_span("run", 10.0, kind="run"), _span("fold", 2.0, parent=0)])
+        assert roofline.analyze(doc)["aggregate"] is None
+
+    def test_render_sharded_lines(self):
+        doc = _doc(
+            {"shard": {"flops": 1e12, "bytes_accessed": 1e10, "span": None,
+                       "devices": 8, "collective_bytes": 3e9}},
+            [_span("run", 10.0, kind="run"), _span("shard", 2.0, parent=0)])
+        text = roofline.render(roofline.analyze(doc))
+        assert "dev" in text  # per-device column header
+        assert "8-device aggregate roof" in text
+        assert "t_comm/t_roof" in text and "comm-bound" in text
 
 
 class TestRooflineCLI:

@@ -371,9 +371,9 @@ class TestExports:
         doc = _synthetic("run-a", 2.0, {"fold": 0.5},
                          counters={"events_folded": 9})
         text = report.prometheus(doc)
-        assert 'crimp_tpu_run_wall_seconds{run="run-a"} 2.0' in text
-        assert 'crimp_tpu_counter_total{run="run-a",name="events_folded"} 9' \
-            in text
+        assert 'crimp_tpu_run_wall_seconds{run="run-a",host="0"} 2.0' in text
+        assert ('crimp_tpu_counter_total{run="run-a",host="0",'
+                'name="events_folded"} 9') in text
         assert 'path="pipe/fold"' in text
 
     def test_summary_text(self):
@@ -736,3 +736,180 @@ class TestProfilingForce:
         out = profiling.force({"a": [jax.numpy.ones(2), (3.0,)]})
         np.testing.assert_array_equal(out["a"][0], [1.0, 1.0])
         assert isinstance(out["a"][1], tuple)
+
+
+# ---------------------------------------------------------------------------
+# Multi-host identity: per-process artifact suffixing
+# ---------------------------------------------------------------------------
+
+
+class TestMultiHost:
+    def test_host_override_suffixes_every_artifact(self, obs_on, monkeypatch):
+        """CRIMP_TPU_OBS_HOST engages multi-host naming: events stream,
+        manifest AND heartbeat sidecar (the collision regression — two
+        processes sharing an obs dir used to overwrite one sidecar) all
+        carry the host suffix, and the run id drops the pid so every
+        host of one run agrees on it."""
+        monkeypatch.setenv("CRIMP_TPU_OBS_HOST", "1")
+        monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", "0.0001")
+        with obs.run("mh") as rec:
+            with obs.span("stage_a"):
+                obs.beat(1, 2, label="chunk")
+        assert rec.host == 1 and rec.hosts >= 2
+        assert "-mh-r" in rec.run_id and f"-p{rec.run_id}" not in rec.run_id
+        assert (obs_on / f"{rec.run_id}.host1.events.jsonl").exists()
+        assert (obs_on / f"{rec.run_id}.host1.manifest.json").exists()
+        assert (obs_on / f"{rec.run_id}.host1.heartbeat.json").exists()
+        assert not (obs_on / f"{rec.run_id}.heartbeat.json").exists()
+        assert not (obs_on / f"{rec.run_id}.events.jsonl").exists()
+        doc = load_manifest(obs_on / f"{rec.run_id}.host1.manifest.json")
+        assert doc["host"] == 1 and doc["host_count"] >= 2
+
+    def test_single_host_names_stay_unsuffixed(self, obs_on, monkeypatch):
+        monkeypatch.delenv("CRIMP_TPU_OBS_HOST", raising=False)
+        with obs.run("solo") as rec:
+            pass
+        assert rec.host == 0 and rec.host_tag == ""
+        assert "-mh-" not in rec.run_id  # single-host ids keep the pid
+        assert (obs_on / f"{rec.run_id}.events.jsonl").exists()
+        assert (obs_on / f"{rec.run_id}.manifest.json").exists()
+
+
+# ---------------------------------------------------------------------------
+# Multi-host trace aggregation: obs merge
+# ---------------------------------------------------------------------------
+
+
+def _host_stream(dirpath, run_id, host, *, spans=(), counters=None,
+                 gauges=None, cost=None, torn=False, name="pipe",
+                 host_count=2):
+    """Hand-write one per-host event stream (JSONL) for merge tests.
+
+    Synthetic on purpose: two real obs.run() calls in one process get
+    DIFFERENT run ids (the global run sequence increments), while real
+    multi-host hosts share one — which only separate processes can
+    reproduce. ``torn=True`` truncates the final record and omits
+    run_end, simulating a SIGKILLed host."""
+    path = dirpath / f"{run_id}.host{host}.events.jsonl"
+    evs = [{"ev": "run_start", "schema": core.OBS_SCHEMA,
+            "schema_version": core.OBS_SCHEMA_VERSION, "run_id": run_id,
+            "name": name, "host": host, "host_count": host_count,
+            "t_start_unix": 1000.0, "knobs": {"CRIMP_TPU_OBS": "1"},
+            "attrs": {}, "t_s": 0.0}]
+    t = 0.0
+    for i, (sname, dur) in enumerate(spans, start=1):
+        t += dur
+        evs.append({"ev": "span", "i": i, "name": sname, "kind": "stage",
+                    "t0_s": round(t - dur, 6), "dur_s": dur, "parent": 0,
+                    "thread": 0, "attrs": {}, "t_s": round(t, 6)})
+    for k, v in (counters or {}).items():
+        evs.append({"ev": "ctr", "k": k, "v": v, "t_s": t})
+    for k, v in (gauges or {}).items():
+        evs.append({"ev": "gauge", "k": k, "v": v, "t_s": t})
+    for k, row in (cost or {}).items():
+        evs.append({"ev": "cost", "k": k, "row": row, "t_s": t})
+    lines = [json.dumps(e) for e in evs]
+    if torn:
+        lines.append('{"ev": "span", "i": 9, "name": "torn-mid-wri')
+    else:
+        lines.append(json.dumps({"ev": "run_end", "run_id": run_id,
+                                 "wall_s": round(t, 6), "error": None,
+                                 "t_s": round(t, 6)}))
+    path.write_text("\n".join(lines) + "\n")
+    return path
+
+
+RUN_ID = "pipe-20260806T000000-mh-r1"
+
+
+class TestMerge:
+    def _two_hosts(self, tmp_path, torn_host1=True):
+        s0 = _host_stream(tmp_path, RUN_ID, 0,
+                          spans=[("fold", 1.0), ("fit", 0.5)],
+                          counters={"events_folded": 5},
+                          gauges={"mesh_devices": 8})
+        s1 = _host_stream(tmp_path, RUN_ID, 1,
+                          spans=[("fold", 1.2)],
+                          counters={"events_folded": 7},
+                          gauges={"mesh_devices": 4}, torn=torn_host1)
+        return s0, s1
+
+    def test_merge_cli_round_trip(self, tmp_path, capsys):
+        """Two per-host streams (one SIGKILLed mid-write) -> one merged
+        manifest that validates, sums counters, max-es gauges/wall, keeps
+        per-host lane roots, and exports per-host Chrome lanes."""
+        s0, s1 = self._two_hosts(tmp_path)
+        trace = tmp_path / "merged.trace.json"
+        rc = cli.main(["merge", str(s0), str(s1),
+                       "--trace-out", str(trace)])
+        assert rc == 0
+        out_path = capsys.readouterr().out.strip().splitlines()[0]
+        assert out_path.endswith(".merged.manifest.json")
+        assert cli.main(["validate", out_path]) == 0
+        doc = load_manifest(out_path)
+        assert doc["merged"] is True and doc["host_count"] == 2
+        assert doc["run_id"] == RUN_ID
+        assert doc["salvaged"] is True  # host1's torn tail, tolerated
+        assert doc["wall_s"] == pytest.approx(1.5)  # max across hosts
+        assert doc["counters"]["events_folded"] == 12  # summed
+        assert doc["gauges"]["mesh_devices"] == 8  # high-water max
+        lanes = [s for s in doc["spans"] if s["kind"] == "host"]
+        assert [s["name"] for s in lanes] == ["host0", "host1"]
+        assert all(s["parent"] == 0 for s in lanes)
+        assert {h["host"]: h["salvaged"] for h in doc["hosts"]} == {
+            0: False, 1: True}
+        assert doc["hosts"][1]["counters"]["events_folded"] == 7
+        # per-host Chrome lanes: host1 events on pid 2, named lane
+        tdoc = json.loads(trace.read_text())
+        evs = tdoc["traceEvents"]
+        assert any(e.get("pid") == 2 and e.get("ph") == "X" for e in evs)
+        names = [e["args"]["name"] for e in evs
+                 if e.get("ph") == "M" and e.get("name") == "process_name"]
+        assert any(n.startswith("host1") for n in names)
+
+    def test_run_id_mismatch_needs_force(self, tmp_path, capsys):
+        s0 = _host_stream(tmp_path, "pipe-20260806T000000-mh-r1", 0,
+                          spans=[("fold", 1.0)])
+        s1 = _host_stream(tmp_path, "pipe-20260806T000001-mh-r1", 1,
+                          spans=[("fold", 1.0)])
+        assert cli.main(["merge", str(s0), str(s1)]) == 2
+        assert "different run_ids" in capsys.readouterr().err
+        assert cli.main(["merge", str(s0), str(s1), "--force"]) == 0
+
+    def test_dir_target_selects_newest_run_group(self, tmp_path):
+        from crimp_tpu.obs import merge as mrg
+
+        import os as _os
+        old0 = _host_stream(tmp_path, "pipe-20260101T000000-mh-r1", 0,
+                            spans=[("fold", 1.0)])
+        old1 = _host_stream(tmp_path, "pipe-20260101T000000-mh-r1", 1,
+                            spans=[("fold", 1.0)])
+        for p in (old0, old1):
+            _os.utime(p, (1000.0, 1000.0))
+        s0, s1 = self._two_hosts(tmp_path, torn_host1=False)
+        assert mrg.resolve_streams([str(tmp_path)]) == sorted(
+            [str(s0), str(s1)])
+
+    def test_merged_prometheus_has_host_labels(self, tmp_path):
+        from crimp_tpu.obs import merge as mrg
+
+        s0, s1 = self._two_hosts(tmp_path, torn_host1=False)
+        doc = mrg.merge_streams([str(s0), str(s1)])
+        text = report.prometheus(doc)
+        assert ('crimp_tpu_counter_total{run="%s",host="0",'
+                'name="events_folded"} 5' % RUN_ID) in text
+        assert ('crimp_tpu_counter_total{run="%s",host="1",'
+                'name="events_folded"} 7' % RUN_ID) in text
+        assert ('crimp_tpu_run_wall_seconds{run="%s",host="1"} 1.2'
+                % RUN_ID) in text
+
+    def test_ledger_ingests_merged_manifest(self, tmp_path):
+        from crimp_tpu.obs import ledger as ldg
+        from crimp_tpu.obs import merge as mrg
+
+        s0, s1 = self._two_hosts(tmp_path, torn_host1=False)
+        out = mrg.merge_file([str(s0), str(s1)])
+        entries = ldg.entries_from_path(out)
+        assert len(entries) == 1
+        assert entries[0]["kind"] == "obs_manifest"
+        assert entries[0]["metrics"]["run_wall_s"] == pytest.approx(1.5)

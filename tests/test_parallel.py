@@ -16,6 +16,7 @@ import jax.numpy as jnp  # noqa: E402
 
 from crimp_tpu.ops import search  # noqa: E402
 from crimp_tpu.parallel import mesh as pmesh  # noqa: E402
+from crimp_tpu.parallel import registry  # noqa: E402
 
 
 pytestmark = pytest.mark.skipif(
@@ -541,3 +542,165 @@ class TestShardedMultisource:
             for col in survey.SURVEY_TOA_COLUMNS:
                 np.testing.assert_array_equal(a[col].to_numpy(),
                                               b[col].to_numpy())
+
+
+class TestShardingRegistry:
+    """The declarative dispatch table (parallel/registry.py): lookups must
+    hand back exactly the specs the bespoke twins used to hand-write (the
+    bitwise pins above prove the migration was spec-neutral), and the
+    collective accounting must match the ring all-reduce hand math."""
+
+    def _mesh(self, ev_par=4):
+        return pmesh.build_mesh(jax.devices()[:8], event_parallel=ev_par)
+
+    def test_general_sums_specs_match_dispatch(self):
+        from jax.sharding import PartitionSpec as P
+
+        plan = registry.specs_for("sharded_sums_general", self._mesh())
+        assert plan.in_specs("times", "weights", "freqs", "fdots") == (
+            P("events"), P("events"), P("trials"), P(None))
+        assert plan.out_specs == (P(None, None, "trials"),
+                                  P(None, None, "trials"))
+        assert plan.device_count() == 8
+        assert plan.reduce_size() == 4  # events extent of the 4x2 mesh
+
+    def test_grid_sums_has_no_freqs_param(self):
+        plan = registry.specs_for("sharded_sums_grid", self._mesh())
+        with pytest.raises(KeyError, match="freqs"):
+            plan.spec("freqs")  # grid path derives freqs from axis_index
+
+    def test_scalar_leaf_replicates_unknown_param_raises(self):
+        plan = registry.specs_for("delta_refold_sharded", self._mesh())
+        assert plan.spec("n_events", leaf=3.0) == registry.REPLICATED
+        with pytest.raises(KeyError, match="n_events"):
+            plan.spec("n_events")  # no leaf: the name must be registered
+
+    def test_unregistered_kernel_raises(self):
+        with pytest.raises(KeyError, match="no rule matches"):
+            registry.specs_for("mystery_kernel", self._mesh())
+
+    def test_collective_bytes_hand_math(self):
+        """8 devices at event_parallel=4: the psum rings over k=4 events-
+        axis devices; each (2, 3, 300) f64 output is 14400 B globally,
+        sharded 2-way over trials -> 7200 B per shard; two outputs ->
+        B = 14400; ring factor 2*(k-1)/k = 1.5 -> 21600 B/device."""
+        plan = registry.specs_for("sharded_sums_grid", self._mesh(4))
+        outs = [jax.ShapeDtypeStruct((2, 3, 300), jnp.float64)] * 2
+        assert plan.collective_bytes(outs) == pytest.approx(21600.0)
+
+    def test_data_parallel_kernels_move_nothing(self):
+        plan = registry.specs_for("stacked_fold",
+                                  pmesh.source_mesh(jax.devices()[:8]))
+        assert plan.reduce_size() == 1
+        outs = [jax.ShapeDtypeStruct((8, 64), jnp.float64)]
+        assert plan.collective_bytes(outs) == 0.0
+
+
+class TestShardedCostCapture:
+    """The registry plan rides into obs cost capture: a sharded dispatch
+    under an active run must land a per-device row in the manifest with
+    the mesh size, the reduce axes and the ring-model collective bytes."""
+
+    def test_sharded_general_row_in_manifest(self, events, freqs,
+                                             monkeypatch, tmp_path):
+        import json
+
+        from crimp_tpu import obs
+        from crimp_tpu.obs import core as obs_core
+        from crimp_tpu.obs import costmodel
+
+        obs_dir = tmp_path / "obs"
+        monkeypatch.setenv("CRIMP_TPU_OBS", "1")
+        monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(obs_dir))
+        monkeypatch.setenv("CRIMP_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "autotune.json"))
+        monkeypatch.delenv("CRIMP_TPU_OBS_HOST", raising=False)
+        costmodel.reset_mem_cache()
+        # event_parallel=8 -> trials extent 1, so the padded frequency
+        # grid is exactly len(freqs) and the collective is hand-checkable
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=8)
+        try:
+            with obs.run("shardcost") as rec:
+                pmesh.z2_sharded(events, freqs, nharm=2, mesh=mesh,
+                                 trig_dtype=jnp.float64)
+            run_id = rec.run_id
+        finally:
+            obs_core._RUN = None
+        doc = json.loads((obs_dir / f"{run_id}.manifest.json").read_text())
+        row = doc["costmodel"]["sharded_sums_general"]
+        assert row["devices"] == 8
+        assert row["sharded"] is True
+        assert row["reduce_axes"] == ["events"]
+        # two (1, 2, 193) f64 outputs, unsharded over trials (extent 1):
+        # B = 2 * 1*2*193*8 bytes; ring factor 2*(8-1)/8 = 1.75
+        expected = 1.75 * 2 * (1 * 2 * len(freqs) * 8)
+        assert row["collective_bytes"] == pytest.approx(expected)
+
+    def test_roofline_reports_all_three_sharded_paths(self, events, freqs,
+                                                      monkeypatch, tmp_path,
+                                                      capsys):
+        """Acceptance: one 8-virtual-device run exercising the trig-sums,
+        delta-refold and multisource sharded paths; every path lands a
+        per-device cost row and `obs roofline` renders the device column
+        plus the 8-device aggregate roof."""
+        import json
+
+        from crimp_tpu import obs
+        from crimp_tpu.models import timing
+        from crimp_tpu.obs import cli
+        from crimp_tpu.obs import core as obs_core
+        from crimp_tpu.obs import costmodel
+        from crimp_tpu.ops import anchored, deltafold, multisource
+
+        obs_dir = tmp_path / "obs"
+        monkeypatch.setenv("CRIMP_TPU_OBS", "1")
+        monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(obs_dir))
+        monkeypatch.setenv("CRIMP_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "autotune.json"))
+        monkeypatch.delenv("CRIMP_TPU_OBS_HOST", raising=False)
+        monkeypatch.delenv("CRIMP_TPU_SHARD", raising=False)
+        costmodel.reset_mem_cache()
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=8)
+
+        rng = np.random.RandomState(3)
+        tmod = timing.from_dict({"PEPOCH": 58000.0, "F0": 0.1432,
+                                 "F1": -1e-14})
+        segs = [np.sort(58000.0 + 2.0 * i + rng.uniform(0.0, 1.5, 300))
+                for i in range(2)]
+        ph, t_ref = anchored.fold_segments(tmod, segs, delta_fold=0)
+        folded = np.concatenate(ph)
+        anchor_idx = np.repeat(np.arange(2), [t.size for t in segs])
+        delta = anchored.anchor_deltas(np.concatenate(segs), t_ref,
+                                       anchor_idx)
+        dp = np.zeros(deltafold.n_params(0))
+        dp[0] = 3e-10
+        tms = [{"PEPOCH": 58000.0, "F0": 0.14 + 0.003 * i, "F1": -1e-13}
+               for i in range(8)]
+        seg_lists = [[np.sort(rng.uniform(58000.0, 58002.0, 80))]
+                     for _ in range(8)]
+
+        try:
+            with obs.run("accept") as rec:
+                pmesh.z2_sharded(events, freqs, nharm=2, mesh=mesh,
+                                 trig_dtype=jnp.float64)
+                pmesh.delta_refold_sharded(tmod, t_ref, folded, delta,
+                                           anchor_idx, dp)
+                multisource.fold_sources(tms, seg_lists)
+        finally:
+            obs_core._RUN = None
+        manifest = obs_dir / f"{rec.run_id}.manifest.json"
+        doc = json.loads(manifest.read_text())
+        for k in ("sharded_sums_general", "delta_refold_sharded",
+                  "stacked_fold"):
+            assert doc["costmodel"][k]["devices"] == 8, k
+            assert doc["costmodel"][k]["sharded"] is True, k
+        # the sums path psum-reduces; the other two are data parallel
+        assert doc["costmodel"]["sharded_sums_general"]["collective_bytes"] > 0
+        assert doc["costmodel"]["delta_refold_sharded"]["collective_bytes"] == 0
+        assert doc["costmodel"]["stacked_fold"]["collective_bytes"] == 0
+        assert cli.main(["roofline", str(manifest)]) == 0
+        out = capsys.readouterr().out
+        assert "8-device aggregate roof" in out
+        for k in ("sharded_sums_general", "delta_refold_sharded",
+                  "stacked_fold"):
+            assert k in out, k
