@@ -839,6 +839,183 @@ def bench_multisource(batch_sizes=(16, 64, 128), n_int: int = 4,
     return out
 
 
+def bench_serving(rates_hz=(2.0, 4.0, 8.0), n_clients: int = 6,
+                  rounds_per_rate: int = 3, events_per_int: int = 100,
+                  n_int: int = 2, phShiftRes: int = 200,
+                  deadline_s: float | None = None, seed: int = 5) -> dict:
+    """Serving-engine throughput/latency under open-loop Poisson load.
+
+    ``n_clients`` synthetic pulsars are registered once (cold, batched —
+    this seeds each client's delta-fold cache slot), then replayed at
+    each arrival rate with a slightly perturbed ephemeris per round — the
+    returning-client steady state, where a re-timing is one ``B @ dp``
+    refold against the cached fold product, not an exact longdouble
+    refold.  The record carries requests/s and p50/p99 latency per rate
+    plus the delta-fold counter movement proving the steady state ran on
+    the refold path (``delta_fold_refolds`` grew, ``delta_fold_exact_
+    folds`` did not) and the breaker/degradation counters.
+
+    Open-loop: arrivals are scheduled up front; latency includes queue
+    wait (coordinated omission is the failure mode this avoids).
+    """
+    import pandas as pd
+
+    from crimp_tpu import obs, serve
+    from crimp_tpu.ops import deltafold
+    from crimp_tpu.pipelines import survey
+
+    rng = np.random.RandomState(seed)
+    edges = np.linspace(58000.0, 58008.0, n_int + 1)
+    tpl = {"model": "fourier", "nbrComp": 2, "norm": 1.0,
+           "amp_1": 0.3, "amp_2": 0.1, "ph_1": 0.2, "ph_2": 0.05}
+    iv = pd.DataFrame({
+        "ToA_tstart": edges[:-1], "ToA_tend": edges[1:],
+        "ToA_exposure": np.full(n_int, (edges[1] - edges[0]) * 86400.0),
+    })
+    clients = []
+    for i in range(n_clients):
+        times = np.sort(np.concatenate([
+            rng.uniform(lo + 1e-6, hi - 1e-6, events_per_int)
+            for lo, hi in zip(edges[:-1], edges[1:])]))
+        clients.append({"name": f"psr{i:03d}", "times": times,
+                        "f0": 0.12 + 0.003 * (i % 53)})
+
+    def spec_for(client, round_n):
+        # each round re-times with a nudged F0 — the "updated ephemeris"
+        # a returning client brings; the nudge keeps nonlinear_sha fixed
+        # so the fold lands on the cached product's B @ dp path
+        tm = {"PEPOCH": 58000.0, "F0": client["f0"] + round_n * 1e-11,
+              "F1": -1e-13}
+        return survey.SourceSpec(name=client["name"], times=client["times"],
+                                 timing_model=tm, template=dict(tpl),
+                                 intervals=iv)
+
+    def counters():
+        rec = obs.active()
+        return dict(rec.counters) if rec is not None else {}
+
+    deltafold.clear_cache()
+    engine = serve.ServingEngine(phShiftRes=phShiftRes)
+
+    # cold registration round: every client folds exactly once (batched),
+    # seeding its fold-product cache slot
+    for c in clients:
+        engine.submit(spec_for(c, 0))
+    reg = engine.drain_all()
+    reg_errors = sum(1 for r in reg if r.status == "error")
+    log(f"[bench] serving: registered {len(reg)} clients "
+        f"({reg_errors} errors)")
+
+    c0 = counters()
+    out: dict = {"n_clients": n_clients, "rounds_per_rate": rounds_per_rate,
+                 "events_per_int": events_per_int, "rates": []}
+    round_n = 0
+    for rate in rates_hz:
+        specs = []
+        for _ in range(rounds_per_rate):
+            round_n += 1
+            specs.extend(spec_for(c, round_n) for c in clients)
+        summary = serve.run_load(engine, specs, rate, seed=seed + round_n,
+                                 deadline_s=deadline_s)
+        summary.pop("results")
+        out["rates"].append(summary)
+        log(f"[bench] serving rate {rate:g}/s: "
+            f"{summary['requests_per_s']:.2f} req/s, "
+            f"p50 {summary['p50_latency_ms']:.1f} ms, "
+            f"p99 {summary['p99_latency_ms']:.1f} ms "
+            f"({summary['completed']} done, {summary['degraded']} degraded, "
+            f"{summary['errors']} errors, {summary['rejected']} rejected)")
+    c1 = counters()
+
+    def moved(name):
+        return float(c1.get(name, 0)) - float(c0.get(name, 0))
+
+    # the steady-state contract: re-timings ran as delta refolds, not
+    # exact longdouble folds
+    out["delta_fold_refolds"] = moved("delta_fold_refolds")
+    out["delta_fold_exact_folds"] = moved("delta_fold_exact_folds")
+    out["steady_state_on_delta_path"] = bool(
+        out["delta_fold_refolds"] > 0 and out["delta_fold_exact_folds"] == 0)
+    stats = engine.stats()
+    out["engine"] = {k: stats[k] for k in
+                     ("admitted", "rejected", "ok", "degraded", "errors",
+                      "deadline_misses", "steps", "warm_clients")}
+    out["breakers"] = stats["breakers"]
+    # headline metrics (ledger-gated): throughput and tail latency at the
+    # highest offered rate
+    top = out["rates"][-1]
+    out["requests_per_s"] = top["requests_per_s"]
+    out["p50_latency_ms"] = top["p50_latency_ms"]
+    out["p99_latency_ms"] = top["p99_latency_ms"]
+    log(f"[bench] serving steady state on delta path: "
+        f"{out['steady_state_on_delta_path']} "
+        f"(refolds +{out['delta_fold_refolds']:.0f}, exact "
+        f"+{out['delta_fold_exact_folds']:.0f})")
+    return out
+
+
+def serving_main(argv=None) -> int:
+    """``python bench.py bench_serving`` — standalone serving bench.
+
+    Separate from :func:`main` on purpose: the 9-stage batch bench is the
+    round gate and stays byte-for-byte unaffected by the serving layer
+    (off-path inertness); this entry point opens its own flight-recorder
+    run and appends its own ledger record.
+    """
+    import argparse
+
+    from crimp_tpu import obs
+    from crimp_tpu.obs import ledger as obs_ledger
+
+    ap = argparse.ArgumentParser(prog="bench.py bench_serving")
+    ap.add_argument("--rates", default="2,4,8",
+                    help="comma-separated arrival rates (req/s)")
+    ap.add_argument("--clients", type=int, default=6)
+    ap.add_argument("--rounds-per-rate", type=int, default=3)
+    ap.add_argument("--events-per-int", type=int, default=100)
+    ap.add_argument("--deadline-ms", type=float, default=None)
+    args = ap.parse_args(argv)
+    rates = tuple(float(r) for r in args.rates.split(",") if r.strip())
+    if len(rates) < 3:
+        ap.error("need at least 3 arrival rates")
+
+    import os
+
+    from crimp_tpu import knobs
+
+    platform_forced = bool(knobs.env_str("CRIMP_TPU_BENCH_PLATFORM")) or \
+        os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+    platform = choose_platform()
+    with obs.run("bench_serving", platform=platform) as obs_run:
+        res = bench_serving(
+            rates_hz=rates, n_clients=args.clients,
+            rounds_per_rate=args.rounds_per_rate,
+            events_per_int=args.events_per_int,
+            deadline_s=None if args.deadline_ms is None
+            else args.deadline_ms / 1000.0)
+    record = {
+        "metric": "serving_throughput",
+        "unit": "req/s",
+        "platform": platform,
+        "platform_fallback": platform == "cpu" and not platform_forced,
+        "requests_per_s": res["requests_per_s"],
+        "p50_latency_ms": res["p50_latency_ms"],
+        "p99_latency_ms": res["p99_latency_ms"],
+        "steady_state_on_delta_path": res["steady_state_on_delta_path"],
+        "serving": res,
+        # only this run's manifest; last_manifest_path() can be stale
+        # when obs is off but an earlier run recorded one
+        "obs_manifest": obs.last_manifest_path() if obs_run is not None
+        else None,
+    }
+    print(json.dumps(record), flush=True)
+    path = obs_ledger.append_bench_record(record,
+                                          source="bench.py bench_serving")
+    if path:
+        log(f"[bench] ledger: serving record appended to {path}")
+    return 0
+
+
 def bench_north_star(par_path: str, template_path: str, times: np.ndarray, intervals,
                      n_freq: int = 2500, n_fdot: int = 40, poly_trig: bool = False) -> dict:
     """The BASELINE north star as ONE wall clock: full 2-D (nu, nudot) Z^2
@@ -1293,4 +1470,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "bench_serving":
+        sys.exit(serving_main(sys.argv[2:]))
     main()

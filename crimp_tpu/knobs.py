@@ -197,6 +197,21 @@ REGISTRY: dict[str, Knob] = _build_registry((
     Knob("CRIMP_TPU_TIER_FORCE_CPU", "unset", "bool",
          consumer="tests/test_tpu_tier.py + scripts/onchip_session.sh",
          doc="run the tier's workloads at tiny scale on CPU (dry-run plumbing)"),
+    # -- serving (host-side orchestration; numeric-neutral by contract) -----
+    Knob("CRIMP_TPU_SERVE_QUEUE", "64", "int",
+         consumer="crimp_tpu/serve/admission.py",
+         doc="admission-queue capacity; a full queue rejects new requests "
+             "with a typed RESOURCE_EXHAUSTED (backpressure, never "
+             "unbounded blocking)"),
+    Knob("CRIMP_TPU_SERVE_DEADLINE_MS", "unset (no default deadline)", "float",
+         consumer="crimp_tpu/serve/scheduler.py",
+         doc="default per-request deadline for requests submitted without "
+             "one; the scheduler degrades pre-emptively when the remaining "
+             "budget cannot afford the top ladder rung"),
+    Knob("CRIMP_TPU_SERVE_BREAKER", "5", "int",
+         consumer="crimp_tpu/serve/breaker.py",
+         doc="consecutive classified failures at a ladder rung before its "
+             "circuit breaker opens (half-opens on probe); 0 disables"),
     # -- resilience ---------------------------------------------------------
     Knob("CRIMP_TPU_FAULTS", "unset (injector disarmed)", "str",
          consumer="crimp_tpu/resilience/faultinject.py",

@@ -16,6 +16,10 @@ Subcommands:
 - ``salvage EVENTS``          reconstruct a manifest from a killed run's
                               event stream (``"salvaged": true``)
 - ``tail TARGET``             follow a live event stream (progress/ETA)
+- ``heartbeat-check SIDECAR --max-age-s N``
+                              liveness probe: exit 0 when the sidecar is
+                              fresher than N seconds, 1 when stale,
+                              missing or torn
 - ``ledger add|show|check``   the append-only performance ledger
 
 Exit codes: 0 = ok, 1 = validation problems / drift found with
@@ -30,6 +34,7 @@ import argparse
 import json
 import sys
 
+from crimp_tpu.obs import heartbeat as hbt
 from crimp_tpu.obs import ledger as ldg
 from crimp_tpu.obs import merge as mrg
 from crimp_tpu.obs import report as rpt
@@ -113,6 +118,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="poll period in seconds")
     tl.add_argument("--max-seconds", type=float, default=None,
                     help="give up (exit 1) after this long without run_end")
+
+    hb = sub.add_parser(
+        "heartbeat-check", help="liveness-probe a heartbeat sidecar "
+                                "(exit 0 fresh, 1 stale/missing/torn)")
+    hb.add_argument("sidecar", help="*.heartbeat.json file or a run "
+                                    "directory (newest sidecar wins)")
+    hb.add_argument("--max-age-s", type=float, required=True,
+                    help="maximum sidecar age in seconds to count as alive")
+    hb.add_argument("--format", choices=("text", "json"), default="text")
 
     lg = sub.add_parser("ledger", help="append-only performance ledger: "
                                        "classify records, baseline, gate")
@@ -277,6 +291,19 @@ def main(argv: list[str] | None = None) -> int:
             return slv.tail(args.target, follow=not args.once,
                             interval=args.interval,
                             max_seconds=args.max_seconds)
+
+        if args.cmd == "heartbeat-check":
+            # missing/torn/stale are NOT usage errors: check_sidecar
+            # absorbs them into (fresh=False, reason) so a dead service
+            # probes as exit 1, never 2
+            fresh, reason, doc = hbt.check_sidecar(args.sidecar,
+                                                   args.max_age_s)
+            if args.format == "json":
+                print(json.dumps({"fresh": fresh, "reason": reason,
+                                  "heartbeat": doc}, indent=2))
+            else:
+                print(f"heartbeat-check: {reason}")
+            return 0 if fresh else 1
 
         if args.cmd == "ledger":
             return _cmd_ledger(args)

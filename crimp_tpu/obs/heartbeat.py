@@ -160,6 +160,53 @@ def beat(done: float, total: float | None, label: str | None = None,
     return doc
 
 
+def check_sidecar(target: str, max_age_s: float,
+                  now_unix: float | None = None) -> tuple[bool, str, dict | None]:
+    """Liveness-probe a heartbeat sidecar: ``(fresh, reason, doc)``.
+
+    ``target`` is a ``*.heartbeat.json`` file or a run directory (the
+    newest sidecar in it wins — the serving/liveness probe case where the
+    prober knows the obs dir, not the run id).  Freshness compares the
+    sidecar's wall-clock ``t_unix`` stamp against ``now_unix`` (default:
+    ``time.time()``): fresh iff ``now - t_unix <= max_age_s``.
+
+    Missing, torn (partially-written or unparseable — the atomic-rename
+    contract makes this "should never happen", which is exactly why a
+    probe must treat it as dead, not crash) and stale sidecars are all
+    NOT-fresh outcomes with a reason, never exceptions: a liveness probe
+    that errors out is indistinguishable from a dead service.
+    """
+    max_age_s = float(max_age_s)
+    if not (max_age_s > 0):
+        raise ValueError(
+            f"max_age_s={max_age_s!r} out of range (expected > 0)")
+    path = target
+    if os.path.isdir(target):
+        cands = sorted(
+            (os.path.join(target, f) for f in os.listdir(target)
+             if f.endswith(".heartbeat.json")),
+            key=lambda p: os.path.getmtime(p))
+        if not cands:
+            return False, f"no *.heartbeat.json in {target}", None
+        path = cands[-1]
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except FileNotFoundError:
+        return False, f"missing: {path}", None
+    except (OSError, ValueError) as exc:
+        return False, f"torn/unreadable: {path} ({exc})", None
+    t_unix = doc.get("t_unix") if isinstance(doc, dict) else None
+    if not isinstance(t_unix, (int, float)):
+        return False, f"torn: {path} has no t_unix stamp", doc \
+            if isinstance(doc, dict) else None
+    age = (time.time() if now_unix is None else float(now_unix)) - t_unix
+    if age > max_age_s:
+        return False, f"stale: last beat {age:.1f}s ago " \
+                      f"(max {max_age_s:g}s)", doc
+    return True, f"fresh: last beat {age:.1f}s ago", doc
+
+
 def scan_progress(base: float = 0, total: float | None = None,
                   label: str | None = None, echo=None):
     """A ``progress(i, n)``-shaped callback that feeds :func:`beat`.

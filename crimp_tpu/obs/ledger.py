@@ -68,6 +68,8 @@ METRICS: dict[str, dict] = {
     "warmup_s": {"field": "warmup_s", "better": "lower"},
     "backend_compile_s": {"field": ("compile_cache", "backend_compile_s"),
                           "better": "lower"},
+    "requests_per_s": {"field": "requests_per_s", "better": "higher"},
+    "p99_latency_ms": {"field": "p99_latency_ms", "better": "lower"},
 }
 
 

@@ -462,6 +462,38 @@ def _disk_put(key: str, prod: FoldProduct, disk_dir: pathlib.Path) -> None:
         logger.warning("fold cache write failed (%s); continuing", exc)
 
 
+def store_product(tm, times_cat, sizes, t_ref, phases,
+                  tag: str | None = None) -> str | None:
+    """Seed the fold cache with an exact fold computed elsewhere.
+
+    The multisource batched fold (ops/multisource.fold_sources) is
+    bit-identical per source to the exact single-source path but never
+    routes through this cache; the serving engine seeds each cold
+    client's batched fold here (``tag`` = client name) so that client's
+    NEXT request takes the cache-hit / ``B @ dp`` delta path instead of a
+    fresh exact fold.  Returns the cache key, or None when the cache tier
+    is off.
+    """
+    mode, disk_dir = fold_cache_mode()
+    if mode == "off":
+        return None
+    tm = timing.resolve(tm)
+    key = fold_key(times_cat, sizes, t_ref, model_sha=nonlinear_sha(tm),
+                   tag=tag)
+    prod = FoldProduct(
+        phases=np.ascontiguousarray(np.asarray(phases, dtype=np.float64)),
+        t_ref=np.asarray(t_ref, dtype=np.float64),
+        sizes=tuple(int(s) for s in sizes),
+        pvec=linear_param_vector(tm),
+        nonlin=nonlinear_sha(tm),
+    )
+    _mem_put(key, prod)
+    if mode == "disk":
+        _disk_put(key, prod, disk_dir)
+    obs.counter_add("delta_fold_seeded")
+    return key
+
+
 def _ensure_basis(prod: FoldProduct, tm, delta, anchor_idx) -> FoldBasis:
     if prod.basis is None:
         prod.basis = build_basis(tm, prod.t_ref, delta, anchor_idx)

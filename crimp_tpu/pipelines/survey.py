@@ -191,7 +191,8 @@ def _centered_seconds(seg_times: list[np.ndarray]) -> tuple[np.ndarray, np.ndarr
 
 def measure_source_toas(spec: SourceSpec, phShiftRes: int = 1000,
                         nbrBins: int = 15, varyAmps: bool = False,
-                        _prep: _Prepped | None = None) -> pd.DataFrame:
+                        _prep: _Prepped | None = None,
+                        delta_fold=None) -> pd.DataFrame:
     """Single-source in-memory ToA measurement — the survey's per-source
     fallback AND parity reference.
 
@@ -199,6 +200,10 @@ def measure_source_toas(spec: SourceSpec, phShiftRes: int = 1000,
     padded batch fit with the same size-ratio bucketing branch, per-ToA
     H-test at the local ephemeris frequency) without any of its file
     outputs; returns the per-source ToA DataFrame (SURVEY_TOA_COLUMNS).
+    ``delta_fold`` passes through to ``anchored.fold_segments`` (the
+    serving engine forces the delta engine on for returning clients;
+    ``None`` defers to the autotune resolution, off by default, and stays
+    bit-identical to the pre-engine path).
     """
     prep = _prep if _prep is not None else _prep_source(
         spec, phShiftRes, nbrBins, varyAmps
@@ -206,7 +211,7 @@ def measure_source_toas(spec: SourceSpec, phShiftRes: int = 1000,
     if not prep.seg_times:
         return _empty_frame()
     seg_phase_list, toa_mids = anchored.fold_segments(
-        prep.tm, prep.seg_times, cache_tag=spec.name
+        prep.tm, prep.seg_times, cache_tag=spec.name, delta_fold=delta_fold
     )
     if prep.kind in (profiles.CAUCHY, profiles.VONMISES):
         seg_phase_list = [p * (2 * np.pi) for p in seg_phase_list]
@@ -226,6 +231,41 @@ def measure_source_toas(spec: SourceSpec, phShiftRes: int = 1000,
     sec, msk = _centered_seconds(prep.seg_times)
     h_powers = np.asarray(search.h_power_segments(sec, msk, freqs_mid, nharm=5))
     return _assemble_frame(prep, toa_mids, results, h_powers)
+
+
+def compute_bucket(ps: list[_Prepped]):
+    """Batched fold + fit + H-test for one bucket of prepped sources.
+
+    ``ps`` share (kind, cfg, n_comp) — the executable-sharing grouping the
+    survey driver and the serving engine both apply before bucketing.
+    Returns ``(frames, phase_lists, t_refs)``: the per-source ToA frames,
+    plus the RAW cycle-folded phase lists and anchors (pre any radians
+    conversion) so callers can seed the delta-fold cache with the
+    bit-identical fold product.  Shared by :func:`_survey_impl` and the
+    serving engine's continuous-batching dispatch (crimp_tpu/serve).
+    """
+    kind, cfg = ps[0].kind, ps[0].cfg
+    phase_lists, t_refs = multisource.fold_sources(
+        [p.tm for p in ps], [p.seg_times for p in ps]
+    )
+    fit_lists = phase_lists
+    if kind in (profiles.CAUCHY, profiles.VONMISES):
+        fit_lists = [[ph * (2 * np.pi) for ph in pl] for pl in phase_lists]
+    results, slices = multisource.fit_sources(
+        kind, [p.tpl for p in ps], fit_lists,
+        [p.exposures for p in ps], cfg,
+    )
+    freqs_list = [spin_frequency_host(p.tm, t_refs[r])[0]
+                  for r, p in enumerate(ps)]
+    h_list = multisource.h_power_sources(
+        [p.seg_times for p in ps], freqs_list
+    )
+    frames = []
+    for r, p in enumerate(ps):
+        res_r = {k: v[slices[r]] for k, v in results.items()}
+        frames.append(_assemble_frame(p, t_refs[r], res_r, h_list[r])
+                      if p.seg_times else _empty_frame())
+    return frames, phase_lists, t_refs
 
 
 def survey_measure_toas(specs, phShiftRes: int = 1000, nbrBins: int = 15,
@@ -298,29 +338,12 @@ def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
     while queue:
         bucket = queue.pop(0)
         ps = [preps[i] for i in bucket]
-        kind, cfg = ps[0].kind, ps[0].cfg
         try:
             faultinject.fire("survey_bucket")
-            phase_lists, t_refs = multisource.fold_sources(
-                [p.tm for p in ps], [p.seg_times for p in ps]
-            )
-            if kind in (profiles.CAUCHY, profiles.VONMISES):
-                phase_lists = [[ph * (2 * np.pi) for ph in pl]
-                               for pl in phase_lists]
-            results, slices = multisource.fit_sources(
-                kind, [p.tpl for p in ps], phase_lists,
-                [p.exposures for p in ps], cfg,
-            )
-            freqs_list = [spin_frequency_host(p.tm, t_refs[r])[0]
-                          for r, p in enumerate(ps)]
-            h_list = multisource.h_power_sources(
-                [p.seg_times for p in ps], freqs_list
-            )
+            bucket_frames, _, _ = compute_bucket(ps)
             width = max(max((p.max_seg for p in ps), default=1), 1)
-            for r, (i, p) in enumerate(zip(bucket, ps)):
-                res_r = {k: v[slices[r]] for k, v in results.items()}
-                frames[i] = _assemble_frame(p, t_refs[r], res_r, h_list[r]) \
-                    if p.seg_times else _empty_frame()
+            for i, p, frame in zip(bucket, ps, bucket_frames):
+                frames[i] = frame
                 occ_used += sum(t.size for t in p.seg_times)
                 occ_total += width * len(p.seg_times)
         except Exception as exc:  # noqa: BLE001 — the bucket failure

@@ -2,9 +2,12 @@
 
 ``CRIMP_TPU_FAULTS="oom:fold_sources:2,corrupt:fold_cache:1"`` arms the
 injector: the named point raises the named fault kind on exactly its N-th
-call (1-based), then disarms.  With the knob unset, ``fire()`` is a single
-knob-registry read and an early return — no parsing, no allocation, no
-writes — so production hot paths stay bit- and perf-identical.
+call (1-based), then disarms.  The repeating form ``kind:point:n+`` fires
+on the n-th AND every subsequent call — sustained pressure for serving
+chaos, where a one-shot fault only proves the first retry.  With the knob
+unset, ``fire()`` is a single knob-registry read and an early return — no
+parsing, no allocation, no writes — so production hot paths stay bit- and
+perf-identical.
 
 Fault points are a closed registry (``FAULT_POINTS``); a spec naming an
 unknown point or kind raises ValueError at parse time so typos fail loudly
@@ -29,6 +32,9 @@ FAULT_POINTS = frozenset({
     "survey_bucket",   # pipelines/survey.py: batched bucket processing
     "tuner_cache",     # ops/autotune.py: tuner cache JSON load
     "scan_chunk",      # ops/resumable.py: chunk compute + chunk resume load
+    "serve_admission",  # serve/admission.py: request admission
+    "serve_dispatch",  # serve/engine.py: batched/warm request dispatch
+    "serve_deadline",  # serve/scheduler.py: deadline-budget evaluation
 })
 
 # Spec kind name -> FailureKind the injected exception will classify as.
@@ -42,7 +48,8 @@ KIND_NAMES = {
     "unknown": FailureKind.UNKNOWN,
 }
 
-# (spec string, {point: {"calls": int, "arms": [(kind_name, n), ...]}})
+# (spec string, {point: {"calls": int,
+#                         "arms": [(kind_name, n, repeat), ...]}})
 _PLAN: tuple[str, dict] | None = None
 
 
@@ -65,16 +72,20 @@ def _parse(spec: str) -> dict:
             raise ValueError(
                 f"CRIMP_TPU_FAULTS point {point!r}: "
                 f"want one of {sorted(FAULT_POINTS)}")
+        repeat = n_str.endswith("+")
+        if repeat:
+            n_str = n_str[:-1]
         try:
             n = int(n_str)
         except ValueError:
             raise ValueError(
-                f"CRIMP_TPU_FAULTS entry {item!r}: n must be an int") from None
+                f"CRIMP_TPU_FAULTS entry {item!r}: n must be an int "
+                "(optionally with a trailing + for repeating fire)") from None
         if n < 1:
             raise ValueError(
                 f"CRIMP_TPU_FAULTS entry {item!r}: n must be >= 1")
         plan.setdefault(point, {"calls": 0, "arms": []})
-        plan[point]["arms"].append((kind_name, n))
+        plan[point]["arms"].append((kind_name, n, repeat))
     return plan
 
 
@@ -108,9 +119,9 @@ def fire(point: str) -> None:
     if state is None:
         return
     state["calls"] += 1
-    for kind_name, n in state["arms"]:
-        if state["calls"] == n:
-            raise _make(kind_name, point, n)
+    for kind_name, n, repeat in state["arms"]:
+        if state["calls"] == n or (repeat and state["calls"] >= n):
+            raise _make(kind_name, point, state["calls"])
 
 
 def reset() -> None:

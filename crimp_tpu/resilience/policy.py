@@ -95,16 +95,25 @@ def default_policy() -> RetryPolicy:
     )
 
 
-def retry_call(fn, *, point: str, policy: RetryPolicy | None = None):
+def retry_call(fn, *, point: str, policy: RetryPolicy | None = None,
+               deadline_s: float | None = None):
     """Call ``fn()``; retry retryable kinds up to ``policy.retries`` times.
 
     A successful retry is bit-identical to a clean first attempt (same
     numeric mode, same inputs).  Non-retryable kinds and exhausted budgets
     re-raise the original exception, already classified by the caller's
     failure domain.
+
+    ``deadline_s`` is the caller's remaining SLO budget, counted from this
+    call's start: when the computed backoff sleep would overrun what is
+    left of it, the retry is skipped and the original (classified)
+    exception re-raises immediately — retries can never blow a caller's
+    deadline.  A budget exactly equal to the delay still retries (the
+    sleep fits); with no deadline the path is unchanged.
     """
     if policy is None:
         policy = default_policy()
+    t0 = time.perf_counter() if deadline_s is not None else None
     attempt = 0
     while True:
         try:
@@ -113,13 +122,22 @@ def retry_call(fn, *, point: str, policy: RetryPolicy | None = None):
             kind = taxonomy.classify(exc)
             if kind not in policy.kinds or attempt >= policy.retries:
                 raise
+            delay = policy.delay_s(attempt, point)
+            if deadline_s is not None:
+                remaining = deadline_s - (time.perf_counter() - t0)
+                if delay > remaining:
+                    obs.counter_add("retries_deadline_skipped", 1)
+                    logger.warning(
+                        "not retrying %s after %s: backoff %.3fs exceeds "
+                        "remaining deadline budget %.3fs",
+                        point, kind.value, delay, remaining)
+                    raise
             obs.counter_add("retries", 1)
             obs.counter_add(f"retries_{point}", 1)
             logger.warning(
                 "retrying %s after %s (%s; attempt %d of %d)",
                 point, kind.value, type(exc).__name__,
                 attempt + 1, policy.retries)
-            delay = policy.delay_s(attempt, point)
             if delay > 0:
                 time.sleep(delay)
             attempt += 1

@@ -1,0 +1,35 @@
+"""Serving layer: a resident timing service over the batch engine.
+
+The batch CLI pays dispatch, compile-cache lookup, and host staging per
+invocation; a timing service amortizes them across a process lifetime.
+:class:`ServingEngine` holds the AOT-warmed executables and the
+delta-fold cache resident, admits requests through a BOUNDED queue
+(backpressure, typed rejections), forms continuous batches through the
+multisource engine, and degrades along the parity-pinned resilience
+ladder — pre-emptively when a deadline budget demands it, reactively
+when a dispatch fails, with per-rung circuit breakers remembering sick
+rungs.
+
+The serving contract (docs/serving.md): every request either completes
+bit-identically, completes degraded (stamped via ``record_degradation``),
+or is rejected at admission with a taxonomy kind.  No request ever
+returns an unclassified error.
+
+Off-path inertness: nothing imports this package unless serving is used;
+batch pipelines are bit-identical with or without it.
+"""
+
+from crimp_tpu.serve.admission import (AdmissionQueue, AdmissionRejected,
+                                       TimingRequest, queue_capacity)
+from crimp_tpu.serve.breaker import RungBreakers, breaker_threshold
+from crimp_tpu.serve.engine import RequestResult, ServingEngine
+from crimp_tpu.serve.loadgen import poisson_arrivals, run_load
+from crimp_tpu.serve.scheduler import (DeadlineScheduler, LADDER,
+                                       default_deadline_s)
+
+__all__ = [
+    "AdmissionQueue", "AdmissionRejected", "DeadlineScheduler", "LADDER",
+    "RequestResult", "RungBreakers", "ServingEngine", "TimingRequest",
+    "breaker_threshold", "default_deadline_s", "poisson_arrivals",
+    "queue_capacity", "run_load",
+]

@@ -1,0 +1,150 @@
+"""Bounded admission queue with backpressure.
+
+The serving engine's front door: requests enter through
+:meth:`AdmissionQueue.offer`, which either accepts (the request becomes a
+row in the next continuous-batching dispatch) or raises a TYPED
+:class:`AdmissionRejected` carrying a taxonomy :class:`FailureKind` — a
+full queue is RESOURCE_EXHAUSTED backpressure, a malformed request is
+DATA_ERROR.  The queue never blocks and never grows without bound: under
+overload the caller learns immediately and can shed, retry elsewhere, or
+wait — the engine's own latency never inflates by queue depth it cannot
+serve.
+
+Capacity comes from ``CRIMP_TPU_SERVE_QUEUE`` (default 64); the
+``serve_admission`` fault point fires inside :meth:`offer` so chaos tests
+can drive admission-time failures — an injected fault surfaces as the
+same classified rejection an organic one would.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from crimp_tpu import knobs, obs
+from crimp_tpu.resilience import faultinject, taxonomy
+from crimp_tpu.resilience.taxonomy import CrimpError, FailureKind
+
+DEFAULT_QUEUE_CAP = 64
+
+
+class AdmissionRejected(CrimpError):
+    """A request refused at the front door; ``kind`` says why.
+
+    RESOURCE_EXHAUSTED = queue full (backpressure — try again later);
+    DATA_ERROR = the request itself is malformed (retrying is pointless);
+    other kinds surface injected/organic admission-path failures.
+    """
+
+    def __init__(self, message: str, kind: FailureKind):
+        super().__init__(message)
+        self.kind = kind
+
+
+@dataclass
+class TimingRequest:
+    """One timing request: a survey SourceSpec plus its SLO budget.
+
+    ``spec.name`` doubles as the client identity — it namespaces the
+    client's delta-fold cache slot (``cache_tag``), so a returning client
+    re-times as one ``B @ dp`` matmul against its cached fold product.
+    ``deadline_s`` is the request's latency budget in seconds from
+    submission; None defers to ``CRIMP_TPU_SERVE_DEADLINE_MS`` (unset =
+    no deadline).  ``submitted_at`` (perf_counter seconds) is stamped at
+    admission; the load generator pre-stamps the scheduled arrival time
+    so open-loop latencies include queue wait.
+    """
+
+    spec: object
+    deadline_s: float | None = None
+    submitted_at: float | None = None
+    fit_kwargs: dict = field(default_factory=dict)
+
+    @property
+    def client_id(self) -> str:
+        return str(getattr(self.spec, "name", ""))
+
+
+def queue_capacity() -> int:
+    """CRIMP_TPU_SERVE_QUEUE (default 64); zero or negative raises."""
+    cap = knobs.env_int("CRIMP_TPU_SERVE_QUEUE", DEFAULT_QUEUE_CAP)
+    if cap < 1:
+        raise ValueError(
+            f"CRIMP_TPU_SERVE_QUEUE={cap!r} out of range (expected >= 1)")
+    return cap
+
+
+class AdmissionQueue:
+    """FIFO of admitted requests, capped; full = typed rejection."""
+
+    def __init__(self, capacity: int | None = None):
+        self.capacity = int(capacity) if capacity is not None \
+            else queue_capacity()
+        if self.capacity < 1:
+            raise ValueError("admission queue capacity must be >= 1")
+        self._q: deque[TimingRequest] = deque()
+        self.admitted = 0
+        self.rejected = 0
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    def offer(self, request: TimingRequest) -> TimingRequest:
+        """Admit ``request`` or raise :class:`AdmissionRejected`.
+
+        Every failure on this path leaves through the typed rejection —
+        the serving contract's "rejected at admission with a taxonomy
+        kind" leg starts here.
+        """
+        try:
+            faultinject.fire("serve_admission")
+        except Exception as exc:  # noqa: BLE001 — admission failure domain:
+            # injected (or organic) faults become classified rejections
+            self.rejected += 1
+            obs.counter_add("serve_rejected", 1)
+            raise AdmissionRejected(
+                f"admission failed: {exc}", taxonomy.classify(exc)) from exc
+        if not isinstance(request, TimingRequest):
+            self.rejected += 1
+            obs.counter_add("serve_rejected", 1)
+            raise AdmissionRejected(
+                f"expected a TimingRequest, got {type(request).__name__}",
+                FailureKind.DATA_ERROR)
+        if not request.client_id:
+            self.rejected += 1
+            obs.counter_add("serve_rejected", 1)
+            raise AdmissionRejected(
+                "request spec has no name (the client identity)",
+                FailureKind.DATA_ERROR)
+        if request.deadline_s is not None and \
+                not (float(request.deadline_s) > 0.0):
+            self.rejected += 1
+            obs.counter_add("serve_rejected", 1)
+            raise AdmissionRejected(
+                f"deadline_s={request.deadline_s!r} must be > 0",
+                FailureKind.DATA_ERROR)
+        if len(self._q) >= self.capacity:
+            self.rejected += 1
+            obs.counter_add("serve_rejected", 1)
+            obs.counter_add("serve_queue_full", 1)
+            raise AdmissionRejected(
+                f"admission queue full ({self.capacity} pending): "
+                "resource exhausted, retry after the next batch drains",
+                FailureKind.RESOURCE_EXHAUSTED)
+        if request.submitted_at is None:
+            request.submitted_at = time.perf_counter()
+        self._q.append(request)
+        self.admitted += 1
+        obs.counter_add("serve_admitted", 1)
+        return request
+
+    def drain(self, n: int | None = None) -> list[TimingRequest]:
+        """Pop up to ``n`` admitted requests (all of them when None) —
+        the next continuous-batching round's rows."""
+        take = len(self._q) if n is None else min(int(n), len(self._q))
+        return [self._q.popleft() for _ in range(take)]
+
+
+__all__ = ["AdmissionQueue", "AdmissionRejected", "DEFAULT_QUEUE_CAP",
+           "TimingRequest", "queue_capacity"]

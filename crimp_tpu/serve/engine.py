@@ -1,0 +1,417 @@
+"""The resident serving engine: continuous batching over the survey core.
+
+One long-lived :class:`ServingEngine` replaces the run-to-completion
+batch CLI for "millions of users" workloads: requests enter through the
+bounded admission queue (serve/admission.py) and each :meth:`step` is one
+CONTINUOUS-BATCHING round — every admitted request becomes a row in the
+next multisource dispatch (``bucket_sources`` → ``stacked_fold`` via
+``survey.compute_bucket``), so dispatch overhead amortizes across
+whatever arrived since the last round instead of per request.
+
+Request lifecycle (docs/serving.md has the state machine):
+
+1. **admission** — accepted or rejected with a taxonomy kind (bounded
+   queue, backpressure);
+2. **scheduling** — the deadline-aware scheduler (serve/scheduler.py)
+   picks the highest ladder rung the remaining budget affords and the
+   per-rung circuit breakers (serve/breaker.py) admit;
+3. **dispatch** — cold clients batch at the picked rung; RETURNING
+   clients take the delta-fold hot path (``measure_source_toas`` with
+   ``delta_fold=1`` and ``cache_tag`` = client name): a re-timing is one
+   ``B @ dp`` matmul against the cached fold product, seeded from the
+   client's first (batched, bit-identical) fold;
+4. **completion** — every admitted request resolves as ``ok``
+   (bit-identical to the parity-pinned reference path), ``degraded``
+   (stamped via ``record_degradation``), or ``error`` with a classified
+   record (DATA_ERROR never degrades — bad input fails the same on every
+   rung).  No request ever returns an unclassified error.
+
+Failure domains are inherited from ``pipelines/survey.py``: a failed
+bucket splits and retries, a single-request bucket demotes to the
+per-source rung, device-shaped per-source failures get one pinned-CPU
+attempt.  The ``serve_dispatch`` fault point fires on every batched and
+warm dispatch (NOT on the per-source bottom rung — the ladder's floor is
+the clean path, mirroring ``survey_bucket``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from crimp_tpu import obs, resilience
+from crimp_tpu.pipelines import survey
+from crimp_tpu.resilience import faultinject
+from crimp_tpu.resilience.taxonomy import FailureKind
+from crimp_tpu.serve import breaker as breaker_mod
+from crimp_tpu.serve import scheduler as scheduler_mod
+from crimp_tpu.serve.admission import (AdmissionQueue, AdmissionRejected,
+                                       TimingRequest)
+
+logger = logging.getLogger("crimp_tpu.serve")
+
+
+@dataclass
+class RequestResult:
+    """One request's terminal state — the serving contract's unit.
+
+    ``status``: ``ok`` (completed bit-identically on the reference
+    path), ``degraded`` (completed on a lower rung, stamped in the obs
+    manifest), or ``error`` (classified failure record; ``kind`` from
+    the closed taxonomy).  Rejected requests never reach a result — they
+    leave :meth:`ServingEngine.submit` as :class:`AdmissionRejected`.
+    """
+
+    client_id: str
+    status: str
+    frame: object = None
+    rung: str | None = None
+    path: str | None = None  # delta / cache / batched / per_source / ...
+    kind: str | None = None
+    latency_s: float | None = None
+    deadline_miss: bool = False
+    error: dict | None = None
+
+
+@dataclass
+class _Pending:
+    """A drained request moving through one batching round."""
+
+    req: TimingRequest
+    prep: object = None
+    degraded: bool = False
+    rung: str | None = None
+    result: RequestResult | None = None
+    extra: dict = field(default_factory=dict)
+
+
+class ServingEngine:
+    """Long-lived timing service over the multisource batch engine."""
+
+    def __init__(self, queue: AdmissionQueue | None = None,
+                 scheduler: scheduler_mod.DeadlineScheduler | None = None,
+                 breakers: breaker_mod.RungBreakers | None = None,
+                 phShiftRes: int = 1000, nbrBins: int = 15,
+                 varyAmps: bool = False):
+        self.queue = queue if queue is not None else AdmissionQueue()
+        self.scheduler = scheduler if scheduler is not None \
+            else scheduler_mod.DeadlineScheduler()
+        self.breakers = breakers if breakers is not None \
+            else breaker_mod.RungBreakers()
+        self.phShiftRes = int(phShiftRes)
+        self.nbrBins = int(nbrBins)
+        self.varyAmps = bool(varyAmps)
+        self._default_deadline = scheduler_mod.default_deadline_s()
+        self._warm: set[str] = set()  # clients with a seeded fold product
+        self.counts = {"ok": 0, "degraded": 0, "error": 0,
+                       "deadline_miss": 0, "steps": 0}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def warmup(self, **kwargs) -> dict:
+        """AOT-compile the hot kernels before the first request (PR 1's
+        ``warmup()`` + the persistent compile cache)."""
+        import crimp_tpu
+
+        return crimp_tpu.warmup(**kwargs)
+
+    def submit(self, spec, deadline_s: float | None = None) -> TimingRequest:
+        """Admit one request (a survey ``SourceSpec`` or a prebuilt
+        :class:`TimingRequest`); raises :class:`AdmissionRejected` with a
+        taxonomy kind on refusal."""
+        req = spec if isinstance(spec, TimingRequest) \
+            else TimingRequest(spec=spec, deadline_s=deadline_s)
+        if req.deadline_s is None:
+            req.deadline_s = self._default_deadline
+        return self.queue.offer(req)
+
+    # -- one continuous-batching round --------------------------------------
+
+    def step(self) -> list[RequestResult]:
+        """Process everything admitted since the last round; returns one
+        terminal :class:`RequestResult` per drained request (input order)."""
+        batch = self.queue.drain()
+        if not batch:
+            return []
+        self.counts["steps"] += 1
+        pend = [_Pending(req=r) for r in batch]
+        obs.beat(0, len(pend), label="serve", force=True)
+
+        warm: list[_Pending] = []
+        cold: list[_Pending] = []
+        for p in pend:
+            try:
+                p.prep = survey._prep_source(
+                    p.req.spec, self.phShiftRes, self.nbrBins, self.varyAmps)
+            except Exception as exc:  # noqa: BLE001 — per-request failure
+                # domain: a malformed spec fails CLASSIFIED, poisons nothing
+                p.result = self._error_result(p, resilience.error_record(exc))
+                continue
+            (warm if p.req.client_id in self._warm else cold).append(p)
+
+        for p in warm:
+            self._dispatch_warm(p)
+
+        if cold:
+            self._dispatch_cold(cold)
+
+        done = 0
+        for p in pend:
+            if p.result is None:  # defensive: the dispatch paths above
+                # must resolve every request; an unresolved one is a bug,
+                # surfaced as a classified UNKNOWN rather than a None leak
+                p.result = self._error_result(p, resilience.error_record(
+                    RuntimeError("request left unresolved by dispatch")))
+            self._finalize(p)
+            done += 1
+            obs.beat(done, len(pend), label="serve")
+        return [p.result for p in pend]
+
+    def drain_all(self, max_steps: int = 1000) -> list[RequestResult]:
+        """Step until the queue is empty (utility for tests/benches)."""
+        out: list[RequestResult] = []
+        for _ in range(max_steps):
+            if not len(self.queue):
+                break
+            out.extend(self.step())
+        return out
+
+    # -- warm clients: the delta-fold hot path ------------------------------
+
+    def _dispatch_warm(self, p: _Pending) -> None:
+        t0 = time.perf_counter()
+        try:
+            faultinject.fire("serve_dispatch")
+            frame = survey.measure_source_toas(
+                p.req.spec, self.phShiftRes, self.nbrBins, self.varyAmps,
+                _prep=p.prep, delta_fold=1)
+            from crimp_tpu.ops import deltafold
+
+            mode = deltafold.last_fold_info().get("mode") or "exact"
+            p.result = RequestResult(
+                client_id=p.req.client_id, status="ok", frame=frame,
+                rung="batched", path=f"delta_fold:{mode}")
+            obs.counter_add(f"serve_warm_{mode}", 1)
+            self.scheduler.observe("batched", time.perf_counter() - t0)
+        except Exception as exc:  # noqa: BLE001 — warm-path failure domain:
+            # classify; bad data errors out, anything else falls to the
+            # per-source exact rung (stamped degraded)
+            fkind = resilience.classify(exc)
+            if fkind is FailureKind.DATA_ERROR:
+                p.result = self._error_result(p, resilience.error_record(exc))
+                return
+            resilience.record_degradation("multisource", "per_source", fkind)
+            p.degraded = True
+            self._dispatch_solo(p)
+
+    # -- cold clients: batched continuous dispatch --------------------------
+
+    def _dispatch_cold(self, cold: list[_Pending]) -> None:
+        from crimp_tpu.ops import autotune, multisource
+
+        max_seg = max(max((p.prep.max_seg for p in cold), default=1), 1)
+        resolved = autotune.resolve_multisource(len(cold), max_seg)
+        rung_groups: dict[str, list[_Pending]] = {}
+        now = time.perf_counter()
+        for p in cold:
+            if not resolved["multisource"]:
+                # knob off: the per-source loop IS the configured path —
+                # not a degradation
+                rung_groups.setdefault("per_source", []).append(p)
+                p.rung = "per_source"
+                continue
+            remaining = None
+            if p.req.deadline_s is not None and p.req.submitted_at is not None:
+                remaining = p.req.deadline_s - (now - p.req.submitted_at)
+            rung, forced = self.scheduler.pick_rung(remaining, self.breakers)
+            if forced is not None and rung != self.scheduler.ladder[0]:
+                resilience.record_degradation("multisource", rung, forced)
+                obs.counter_add("serve_preemptive_degrades", 1)
+                p.degraded = True
+            p.rung = rung
+            rung_groups.setdefault(rung, []).append(p)
+
+        for rung in ("batched", "split_bucket"):
+            if rung_groups.get(rung):
+                self._dispatch_buckets(rung_groups[rung], rung, resolved)
+        for p in rung_groups.get("per_source", ()):
+            self._dispatch_solo(p)
+
+    def _dispatch_buckets(self, items: list[_Pending], rung: str,
+                          resolved: dict) -> None:
+        from crimp_tpu.ops import multisource
+
+        groups: dict[tuple, list[_Pending]] = {}
+        for p in items:
+            pr = p.prep
+            groups.setdefault((pr.kind, pr.cfg, int(pr.tpl.n_comp)),
+                              []).append(p)
+        queue: list[list[_Pending]] = []
+        for members in groups.values():
+            for b in multisource.bucket_sources(
+                [max(m.prep.max_seg, 1) for m in members],
+                max_pad_ratio=resolved["max_pad"],
+                batch_cap=resolved["batch_cap"],
+            ):
+                bucket = [members[j] for j in b]
+                if rung == "split_bucket" and len(bucket) > 1:
+                    # pre-emptive half-buckets: the rung the scheduler
+                    # picked, taken before dispatch instead of after an OOM
+                    mid = (len(bucket) + 1) // 2
+                    queue.append(bucket[:mid])
+                    queue.append(bucket[mid:])
+                else:
+                    queue.append(bucket)
+
+        while queue:
+            bucket = queue.pop(0)
+            t0 = time.perf_counter()
+            try:
+                faultinject.fire("serve_dispatch")
+                frames, phase_lists, t_refs = survey.compute_bucket(
+                    [m.prep for m in bucket])
+                wall = time.perf_counter() - t0
+                self.breakers.record_success(rung)
+                self.scheduler.observe(rung, wall / len(bucket))
+                for m, frame, pl, tr in zip(bucket, frames, phase_lists,
+                                            t_refs):
+                    self._seed_client(m, pl, tr)
+                    m.result = RequestResult(
+                        client_id=m.req.client_id,
+                        status="degraded" if m.degraded else "ok",
+                        frame=frame, rung=m.rung or rung, path="batched")
+            except Exception as exc:  # noqa: BLE001 — the bucket failure
+                # domain walks the multisource ladder exactly like the
+                # survey driver: split and retry, demote a singleton
+                fkind = resilience.classify(exc)
+                self.breakers.record_failure(rung, fkind)
+                if len(bucket) > 1:
+                    mid = (len(bucket) + 1) // 2
+                    queue.insert(0, bucket[mid:])
+                    queue.insert(0, bucket[:mid])
+                    resilience.record_degradation("multisource",
+                                                  "split_bucket", fkind)
+                    for m in bucket:
+                        m.degraded = True
+                    continue
+                resilience.record_degradation("multisource", "per_source",
+                                              fkind)
+                for m in bucket:
+                    m.degraded = True
+                    self._dispatch_solo(m)
+
+    # -- the ladder floor: per-source (always succeeds or classifies) -------
+
+    def _dispatch_solo(self, p: _Pending) -> None:
+        t0 = time.perf_counter()
+
+        def solo():
+            # delta_fold=1 routes the fold through the fingerprinted
+            # cache: the FIRST request stores the exact product (bits
+            # unchanged), so this client's next request takes the
+            # cache-hit / B@dp path
+            return survey.measure_source_toas(
+                p.req.spec, self.phShiftRes, self.nbrBins, self.varyAmps,
+                _prep=p.prep, delta_fold=1)
+
+        try:
+            frame = solo()
+        except Exception as exc:  # noqa: BLE001 — per-source domain: the
+            # classified record separates data errors from device loss;
+            # device-shaped kinds get one pinned-CPU attempt (the device
+            # ladder's last rung)
+            fkind = resilience.classify(exc)
+            if fkind in resilience.CPU_FALLBACK_KINDS:
+                try:
+                    with resilience.pinned_cpu(fkind):
+                        frame = solo()
+                    p.degraded = True
+                except Exception as exc2:  # noqa: BLE001 — final rung
+                    # failed too: record the classified error
+                    p.result = self._error_result(
+                        p, resilience.error_record(exc2))
+                    return
+            else:
+                p.result = self._error_result(p, resilience.error_record(exc))
+                return
+        self._warm.add(p.req.client_id)
+        self.scheduler.observe("per_source", time.perf_counter() - t0)
+        p.result = RequestResult(
+            client_id=p.req.client_id,
+            status="degraded" if p.degraded else "ok",
+            frame=frame, rung=p.rung or "per_source", path="per_source")
+
+    # -- shared plumbing ----------------------------------------------------
+
+    def _seed_client(self, m: _Pending, phase_list, t_ref) -> None:
+        """Seed the delta-fold cache from a batched (bit-identical) fold
+        so this client's next request re-times as one B@dp matmul."""
+        from crimp_tpu.ops import deltafold
+
+        try:
+            seg_times = m.prep.seg_times
+            sizes = [t.size for t in seg_times]
+            times_cat = np.concatenate(seg_times) if seg_times \
+                else np.zeros(0)
+            phases_cat = np.concatenate(
+                [np.asarray(ph) for ph in phase_list]) if phase_list \
+                else np.zeros(0)
+            deltafold.store_product(m.prep.tm, times_cat, sizes,
+                                    np.asarray(t_ref), phases_cat,
+                                    tag=m.req.client_id)
+            self._warm.add(m.req.client_id)
+        except Exception as exc:  # noqa: BLE001 — seeding is a throughput
+            # optimization; its failure is classified telemetry, never a
+            # request failure (the client simply stays cold)
+            logger.warning("fold-cache seed failed for %s (%s)",
+                           m.req.client_id,
+                           resilience.error_record(exc))
+
+    def _error_result(self, p: _Pending, rec: dict) -> RequestResult:
+        obs.counter_add("serve_errors", 1)
+        logger.warning("request %s failed: %s", p.req.client_id, rec)
+        return RequestResult(
+            client_id=p.req.client_id, status="error", rung=p.rung,
+            kind=rec["kind"], error=rec)
+
+    def _finalize(self, p: _Pending) -> None:
+        res = p.result
+        now = time.perf_counter()
+        if p.req.submitted_at is not None:
+            res.latency_s = now - p.req.submitted_at
+            if p.req.deadline_s is not None and \
+                    res.latency_s > p.req.deadline_s:
+                res.deadline_miss = True
+                self.counts["deadline_miss"] += 1
+                obs.counter_add("serve_deadline_miss", 1)
+        if res.status == "degraded":
+            res.kind = res.kind or None
+        self.counts[res.status] = self.counts.get(res.status, 0) + 1
+        obs.counter_add(f"serve_{res.status}", 1)
+        obs.record_span("serve_request", res.latency_s or 0.0,
+                        kind="request", client=res.client_id,
+                        status=res.status, rung=res.rung or "",
+                        path=res.path or "")
+
+    def stats(self) -> dict:
+        """Engine telemetry: admission, completion, breaker and scheduler
+        state — bench_serving folds this into its ledger record."""
+        return {
+            "admitted": self.queue.admitted,
+            "rejected": self.queue.rejected,
+            "pending": len(self.queue),
+            "ok": self.counts["ok"],
+            "degraded": self.counts["degraded"],
+            "errors": self.counts["error"],
+            "deadline_misses": self.counts["deadline_miss"],
+            "steps": self.counts["steps"],
+            "warm_clients": len(self._warm),
+            "breakers": self.breakers.snapshot(),
+            "rung_latency_est_s": self.scheduler.estimates(),
+        }
+
+
+__all__ = ["RequestResult", "ServingEngine"]

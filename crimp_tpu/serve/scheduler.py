@@ -1,0 +1,112 @@
+"""Deadline-aware rung scheduler: degrade pre-emptively, never fail.
+
+Each admitted request carries a latency budget; the scheduler keeps an
+EWMA of recently OBSERVED per-request latency at every rung of the
+parity-pinned ``resilience.LADDERS["multisource"]`` ladder and, before
+dispatch, picks the HIGHEST rung that (a) its circuit breaker admits and
+(b) the remaining budget can afford.  A request whose budget cannot
+afford the top rung lands on a lower rung *before* burning the budget
+discovering that — the overload story is "coarser batching, same
+classified completion", not a timeout.
+
+The bottom rung is always eligible: an admitted request completes (bit-
+identically or stamped degraded) no matter how sick the upper rungs are —
+the degrade-never-fail half of the serving contract.  Any pick below the
+top rung is stamped through ``resilience.record_degradation`` with the
+kind that forced it (TIMEOUT for budget, the breaker's last classified
+kind for a shed).
+
+The ``serve_deadline`` fault point fires inside the budget evaluation;
+an injected fault there classifies and forces the bottom rung (budget
+treated as spent) — the chaos-test knob for "the SLO machinery itself
+is failing".
+"""
+
+from __future__ import annotations
+
+import logging
+
+from crimp_tpu import knobs, resilience
+from crimp_tpu.resilience import faultinject, taxonomy
+from crimp_tpu.resilience.taxonomy import FailureKind
+from crimp_tpu.serve import breaker as breaker_mod
+
+logger = logging.getLogger("crimp_tpu.serve")
+
+LADDER = resilience.LADDERS["multisource"]  # ("batched", "split_bucket",
+#                                              "per_source")
+EWMA_ALPHA = 0.3
+
+
+def default_deadline_s() -> float | None:
+    """CRIMP_TPU_SERVE_DEADLINE_MS in seconds, or None when unset."""
+    ms = knobs.env_pos_float("CRIMP_TPU_SERVE_DEADLINE_MS")
+    return None if ms is None else ms / 1000.0
+
+
+class DeadlineScheduler:
+    """Pick the best affordable ladder rung for each dispatch."""
+
+    def __init__(self, ladder: tuple = LADDER, alpha: float = EWMA_ALPHA):
+        if not ladder:
+            raise ValueError("scheduler needs a non-empty ladder")
+        self.ladder = tuple(ladder)
+        self.alpha = float(alpha)
+        self._est: dict[str, float] = {}
+
+    def observe(self, rung: str, latency_s: float) -> None:
+        """Feed one observed per-request latency at ``rung`` into the EWMA."""
+        latency_s = float(latency_s)
+        if latency_s < 0:
+            return
+        prev = self._est.get(rung)
+        self._est[rung] = latency_s if prev is None else \
+            self.alpha * latency_s + (1.0 - self.alpha) * prev
+
+    def estimate(self, rung: str) -> float | None:
+        """EWMA latency estimate for ``rung`` (None until observed)."""
+        return self._est.get(rung)
+
+    def estimates(self) -> dict[str, float]:
+        return dict(self._est)
+
+    def pick_rung(self, remaining_s: float | None,
+                  breakers: breaker_mod.RungBreakers | None = None,
+                  ) -> tuple[str, FailureKind | None]:
+        """The rung this request dispatches at, plus the kind that forced
+        a sub-top pick (None = top rung, no degradation to stamp).
+
+        Walks the ladder top-down; a rung is skipped when its breaker
+        sheds (kind = the breaker's last classified failure) or when its
+        latency estimate exceeds the remaining budget (kind = TIMEOUT).
+        The bottom rung is returned unconditionally — shedding there
+        would turn an admitted request into a failure, which the serving
+        contract forbids.
+        """
+        forced: FailureKind | None = None
+        try:
+            faultinject.fire("serve_deadline")
+        except Exception as exc:  # noqa: BLE001 — deadline-machinery
+            # failure domain: classify and treat the budget as spent
+            forced = taxonomy.classify(exc)
+            logger.warning("deadline evaluation failed (%s); forcing the "
+                           "bottom rung", forced.value)
+            return self.ladder[-1], forced
+        for rung in self.ladder[:-1]:
+            if breakers is not None and not breakers.allow(rung):
+                forced = breakers.last_kind(rung) or FailureKind.UNKNOWN
+                continue
+            est = self._est.get(rung)
+            if remaining_s is not None and est is not None \
+                    and est > remaining_s:
+                forced = FailureKind.TIMEOUT
+                continue
+            if remaining_s is not None and remaining_s <= 0.0:
+                forced = FailureKind.TIMEOUT
+                continue
+            return rung, None if rung == self.ladder[0] else forced
+        return self.ladder[-1], forced or (
+            FailureKind.TIMEOUT if remaining_s is not None else None)
+
+
+__all__ = ["DeadlineScheduler", "EWMA_ALPHA", "LADDER", "default_deadline_s"]

@@ -65,6 +65,61 @@ class TestClassify:
                        "backend_compile_s": 12.5}
 
 
+class TestServingMetrics:
+    """bench_serving's requests_per_s / p99_latency_ms join the gate,
+    direction-aware (throughput higher-is-better, tail latency lower)."""
+
+    def _serving_entries(self, tmp_path, rnd, rps, p99, **extra):
+        rec = {"metric": "serving_throughput", "platform": "tpu",
+               "platform_fallback": False, "requests_per_s": rps,
+               "p99_latency_ms": p99, **extra}
+        path = tmp_path / f"BENCH_r{rnd:02d}.json"
+        path.write_text(json.dumps(rec) + "\n")
+        return ledger.entries_from_path(str(path))
+
+    def test_extract_metrics_includes_serving(self):
+        out = ledger.extract_metrics({"requests_per_s": 23.2,
+                                      "p99_latency_ms": 18.5,
+                                      "steady_state_on_delta_path": True})
+        assert out["requests_per_s"] == 23.2
+        assert out["p99_latency_ms"] == 18.5
+        assert "steady_state_on_delta_path" not in out  # bools never gate
+
+    def test_p99_gates_lower_is_better(self, tmp_path):
+        base = self._serving_entries(tmp_path, 6, rps=20.0, p99=10.0)
+        slow = self._serving_entries(tmp_path, 7, rps=20.0, p99=20.0)
+        report = ledger.check(base + slow)
+        assert [r["metric"] for r in report["regressions"]] == \
+            ["p99_latency_ms"]
+        assert report["ok"] is False
+
+    def test_throughput_gates_higher_is_better(self, tmp_path):
+        base = self._serving_entries(tmp_path, 6, rps=20.0, p99=10.0)
+        slow = self._serving_entries(tmp_path, 7, rps=10.0, p99=10.0)
+        report = ledger.check(base + slow)
+        assert [r["metric"] for r in report["regressions"]] == \
+            ["requests_per_s"]
+
+    def test_improvement_in_both_passes(self, tmp_path):
+        base = self._serving_entries(tmp_path, 6, rps=20.0, p99=10.0)
+        fast = self._serving_entries(tmp_path, 7, rps=30.0, p99=5.0)
+        report = ledger.check(base + fast)
+        assert report["ok"] is True
+        assert {r["metric"] for r in report["improvements"]} == \
+            {"requests_per_s", "p99_latency_ms"}
+
+    def test_degraded_serving_round_never_gates(self, tmp_path):
+        # a chaos/degraded serving round is excluded: it can neither
+        # ratchet the baseline down nor fail the gate
+        base = self._serving_entries(tmp_path, 6, rps=20.0, p99=10.0)
+        chaos = self._serving_entries(tmp_path, 7, rps=1.0, p99=900.0,
+                                      degraded=True)
+        report = ledger.check(base + chaos)
+        assert report["ok"] is True
+        assert any(e["class"] == "degraded" for e in report["excluded"])
+        assert report["candidate"]["round"] == 6
+
+
 class TestCommittedRecords:
     """The acceptance fixture: the five BENCH_r*.json in the repo root."""
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import collections
 import json
+import os
 import pathlib
 import subprocess
 import sys
@@ -528,6 +529,84 @@ class TestHeartbeat:
         stream = next(iter(obs_on.glob("*.events.jsonl")))
         assert any(json.loads(ln)["ev"] == "heartbeat"
                    for ln in stream.read_text().splitlines())
+
+
+class TestHeartbeatCheck:
+    """The external liveness probe: stale/missing/torn are RESULTS (exit
+    1), never exceptions — a probe that errors out is indistinguishable
+    from a dead service.  Only operator error (bad max-age) is usage."""
+
+    def _sidecar(self, tmp_path, t_unix, name="r1.heartbeat.json"):
+        p = tmp_path / name
+        p.write_text(json.dumps({"run_id": "r1", "t_unix": t_unix}))
+        return p
+
+    def test_fresh_sidecar_exits_0(self, tmp_path, capsys):
+        p = self._sidecar(tmp_path, time.time())
+        assert cli.main(["heartbeat-check", str(p),
+                         "--max-age-s", "60"]) == 0
+        assert "fresh" in capsys.readouterr().out
+
+    def test_stale_sidecar_exits_1(self, tmp_path, capsys):
+        p = self._sidecar(tmp_path, time.time() - 3600)
+        assert cli.main(["heartbeat-check", str(p),
+                         "--max-age-s", "60"]) == 1
+        assert "stale" in capsys.readouterr().out
+
+    def test_missing_sidecar_exits_1(self, tmp_path, capsys):
+        assert cli.main(["heartbeat-check", str(tmp_path / "nope.json"),
+                         "--max-age-s", "60"]) == 1
+        assert "missing" in capsys.readouterr().out
+
+    def test_torn_sidecar_exits_1(self, tmp_path, capsys):
+        p = tmp_path / "r1.heartbeat.json"
+        p.write_text('{"run_id": "r1", "t_un')  # torn mid-write
+        assert cli.main(["heartbeat-check", str(p),
+                         "--max-age-s", "60"]) == 1
+        assert "torn" in capsys.readouterr().out
+
+    def test_stampless_sidecar_exits_1(self, tmp_path, capsys):
+        p = tmp_path / "r1.heartbeat.json"
+        p.write_text(json.dumps({"run_id": "r1"}))  # valid JSON, no stamp
+        assert cli.main(["heartbeat-check", str(p),
+                         "--max-age-s", "60"]) == 1
+        assert "t_unix" in capsys.readouterr().out
+
+    def test_dir_target_probes_newest_sidecar(self, tmp_path, capsys):
+        stale = self._sidecar(tmp_path, time.time() - 3600,
+                              name="old.heartbeat.json")
+        os.utime(stale, (1, 1))
+        self._sidecar(tmp_path, time.time(), name="new.heartbeat.json")
+        assert cli.main(["heartbeat-check", str(tmp_path),
+                         "--max-age-s", "60"]) == 0
+        capsys.readouterr()
+        # and an empty dir is a dead service, not a crash
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert cli.main(["heartbeat-check", str(empty),
+                         "--max-age-s", "60"]) == 1
+
+    def test_json_format(self, tmp_path, capsys):
+        p = self._sidecar(tmp_path, time.time())
+        assert cli.main(["heartbeat-check", str(p), "--max-age-s", "60",
+                         "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["fresh"] is True
+        assert doc["heartbeat"]["run_id"] == "r1"
+
+    def test_bad_max_age_is_usage_error(self, tmp_path, capsys):
+        p = self._sidecar(tmp_path, time.time())
+        assert cli.main(["heartbeat-check", str(p),
+                         "--max-age-s", "0"]) == 2
+
+    def test_live_engine_sidecar_probes_fresh(self, obs_on, monkeypatch):
+        # end to end: the serving engine's own obs.beat sidecar satisfies
+        # the probe while the run is beating
+        monkeypatch.setenv("CRIMP_TPU_OBS_HEARTBEAT_S", "0.0001")
+        with obs.run("hb"):
+            obs.beat(1, 2, label="serve", force=True)
+            assert cli.main(["heartbeat-check", str(obs_on),
+                             "--max-age-s", "60"]) == 0
 
 
 # ---------------------------------------------------------------------------

@@ -191,6 +191,100 @@ class TestRetryPolicy:
             policy.record_degradation("grid", "warp_drive")
 
 
+class _FrozenTime:
+    """A stand-in for policy.time: the clock never advances, sleeps are
+    recorded — boundary conditions become exact instead of racy."""
+
+    def __init__(self):
+        self.slept = []
+
+    def perf_counter(self):
+        return 1000.0
+
+    def sleep(self, s):
+        self.slept.append(s)
+
+
+class TestDeadlineAwareRetry:
+    def test_insufficient_budget_skips_retry(self, monkeypatch, obs_on):
+        frozen = _FrozenTime()
+        monkeypatch.setattr(policy, "time", frozen)
+        calls = []
+
+        def always_oom():
+            calls.append(1)
+            raise MemoryError("persistent")
+
+        p = policy.RetryPolicy(retries=3, backoff_s=0.1)
+        delay0 = p.delay_s(0, "t")
+        with obs.run("retry_deadline"):
+            with pytest.raises(MemoryError):
+                policy.retry_call(always_oom, point="t", policy=p,
+                                  deadline_s=delay0 * 0.99)
+            counters = dict(obs.active().counters)
+        # the classified failure re-raised immediately: one attempt, no
+        # sleep into a guaranteed deadline miss
+        assert len(calls) == 1
+        assert frozen.slept == []
+        assert counters.get("retries_deadline_skipped") == 1
+        assert "retries" not in counters
+
+    def test_budget_exactly_equal_to_delay_still_retries(self, monkeypatch):
+        frozen = _FrozenTime()
+        monkeypatch.setattr(policy, "time", frozen)
+        calls = []
+
+        def always_oom():
+            calls.append(1)
+            raise MemoryError("persistent")
+
+        p = policy.RetryPolicy(retries=1, backoff_s=0.1)
+        delay0 = p.delay_s(0, "t")
+        with pytest.raises(MemoryError):
+            policy.retry_call(always_oom, point="t", policy=p,
+                              deadline_s=delay0)
+        # the budget AFFORDS the sleep (strict >): the retry happened
+        assert len(calls) == 2
+        assert frozen.slept == [delay0]
+
+    def test_no_deadline_path_unchanged(self, monkeypatch, obs_on):
+        frozen = _FrozenTime()
+        monkeypatch.setattr(policy, "time", frozen)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise MemoryError("transient")
+            return 42
+
+        p = policy.RetryPolicy(retries=1, backoff_s=0.1)
+        with obs.run("retry_nodeadline"):
+            assert policy.retry_call(flaky, point="t", policy=p) == 42
+            counters = dict(obs.active().counters)
+        assert len(calls) == 2
+        assert counters.get("retries") == 1
+        assert "retries_deadline_skipped" not in counters
+
+    def test_deadline_never_rescues_ineligible_kinds(self, monkeypatch):
+        # DATA_ERROR stays never-retried regardless of how much budget
+        # remains — the deadline gate sits after eligibility, not before
+        frozen = _FrozenTime()
+        monkeypatch.setattr(policy, "time", frozen)
+        calls = []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("bad input")
+
+        with pytest.raises(ValueError):
+            policy.retry_call(bad, point="t",
+                              policy=policy.RetryPolicy(retries=5,
+                                                        backoff_s=0.0),
+                              deadline_s=1e9)
+        assert len(calls) == 1
+
+
 # ---------------------------------------------------------------------------
 # fault injector
 # ---------------------------------------------------------------------------
@@ -208,6 +302,8 @@ class TestFaultInjector:
         "zap:fold_cache:1",       # unknown kind
         "oom:fold_cache:x",       # non-int n
         "oom:fold_cache:0",       # n < 1
+        "oom:fold_cache:0+",      # repeating form, n < 1
+        "oom:fold_cache:x+",      # repeating form, non-int n
         "oom:fold_cache",         # missing n
     ])
     def test_typos_fail_loudly(self, monkeypatch, spec):
@@ -224,6 +320,30 @@ class TestFaultInjector:
         assert taxonomy.classify(e.value) is FailureKind.RESOURCE_EXHAUSTED
         for _ in range(10):
             faultinject.fire("scan_chunk")  # disarmed: never fires again
+
+    def test_repeating_form_fires_from_nth_call_onward(self, monkeypatch):
+        # kind:point:n+ is a PERSISTENT fault — the shape that drives a
+        # circuit breaker through open/half-open instead of one blip
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "device:scan_chunk:3+")
+        faultinject.fire("scan_chunk")
+        faultinject.fire("scan_chunk")
+        for _ in range(5):
+            with pytest.raises(taxonomy.InjectedFault) as e:
+                faultinject.fire("scan_chunk")
+            assert taxonomy.classify(e.value) is FailureKind.DEVICE_LOST
+
+    def test_serve_points_are_wired(self, monkeypatch):
+        monkeypatch.setenv(
+            "CRIMP_TPU_FAULTS",
+            "oom:serve_admission:1,device:serve_dispatch:1,"
+            "timeout:serve_deadline:1")
+        for point, kind in (("serve_admission",
+                             FailureKind.RESOURCE_EXHAUSTED),
+                            ("serve_dispatch", FailureKind.DEVICE_LOST),
+                            ("serve_deadline", FailureKind.TIMEOUT)):
+            with pytest.raises(taxonomy.InjectedFault) as e:
+                faultinject.fire(point)
+            assert taxonomy.classify(e.value) is kind
 
     def test_other_points_unaffected(self, monkeypatch):
         monkeypatch.setenv("CRIMP_TPU_FAULTS", "nan:fold_cache:1")

@@ -1,0 +1,608 @@
+"""Serving engine: admission, batching parity, deadlines, breakers, chaos.
+
+The contracts pinned here:
+
+1. **Serving contract** — every request either completes bit-identically
+   (cold batched round, and warm re-timing of an unchanged spec), completes
+   degraded with the manifest stamp to prove it, or is rejected at
+   admission with a taxonomy kind. Under injected chaos at every serve
+   fault point, no request ever returns an unclassified error.
+2. **Continuous-batching parity** — a cold batch round produces frames
+   bitwise equal to per-source ``measure_source_toas`` under the exact-
+   padding contract; a returning client's unchanged re-timing hits the
+   fold-product cache bitwise; a perturbed re-timing runs as a delta
+   refold (refold counter moves, exact-fold counter does not).
+3. **Deadline-aware degradation** — a request whose budget cannot afford
+   the top rung's observed latency lands on a lower rung *pre-emptively*,
+   stamped TIMEOUT; the breaker cycle (closed → open → half-open →
+   closed/reopen) is deterministic in call counts and visible in the
+   manifest counters.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from crimp_tpu import obs  # noqa: E402
+from crimp_tpu import serve  # noqa: E402
+from crimp_tpu.obs import ledger  # noqa: E402
+from crimp_tpu.obs.manifest import load_manifest  # noqa: E402
+from crimp_tpu.ops import deltafold  # noqa: E402
+from crimp_tpu.pipelines import survey  # noqa: E402
+from crimp_tpu.resilience import faultinject, taxonomy  # noqa: E402
+from crimp_tpu.resilience.taxonomy import FailureKind  # noqa: E402
+from crimp_tpu.serve import breaker as breaker_mod  # noqa: E402
+from crimp_tpu.serve import scheduler as scheduler_mod  # noqa: E402
+from crimp_tpu.serve.admission import (AdmissionQueue,  # noqa: E402
+                                       AdmissionRejected, TimingRequest)
+
+TPL = {"model": "fourier", "nbrComp": 2, "norm": 1.0, "amp_1": 0.3,
+       "amp_2": 0.1, "ph_1": 0.2, "ph_2": 0.05}
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    """No stray serve/resilience knobs, disarmed injector, empty cache."""
+    for var in ("CRIMP_TPU_FAULTS", "CRIMP_TPU_RETRIES",
+                "CRIMP_TPU_BACKOFF_S", "CRIMP_TPU_FOLD_CACHE",
+                "CRIMP_TPU_DELTA_FOLD", "CRIMP_TPU_MULTISOURCE",
+                "CRIMP_TPU_SERVE_QUEUE", "CRIMP_TPU_SERVE_DEADLINE_MS",
+                "CRIMP_TPU_SERVE_BREAKER"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("CRIMP_TPU_AUTOTUNE", "0")
+    faultinject.reset()
+    deltafold.clear_cache()
+    yield
+    faultinject.reset()
+    deltafold.clear_cache()
+
+
+@pytest.fixture()
+def obs_on(monkeypatch, tmp_path):
+    out = tmp_path / "obs"
+    monkeypatch.setenv("CRIMP_TPU_OBS", "1")
+    monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(out))
+    return out
+
+
+def _make_spec(i, rng, n_per=60, n_int=2, name=None, f0_bump=0.0):
+    """Equal per-interval counts -> exact padding -> bitwise parity."""
+    edges = np.linspace(58000.0, 58008.0, n_int + 1)
+    times = np.sort(np.concatenate([
+        rng.uniform(lo + 1e-6, hi - 1e-6, n_per)
+        for lo, hi in zip(edges[:-1], edges[1:])
+    ]))
+    iv = pd.DataFrame({
+        "ToA_tstart": edges[:-1], "ToA_tend": edges[1:],
+        "ToA_exposure": np.full(n_int, (edges[1] - edges[0]) * 86400.0),
+    })
+    tm = {"PEPOCH": 58000.0, "F0": 0.14 + 0.003 * (i % 53) + f0_bump,
+          "F1": -1e-13}
+    return survey.SourceSpec(name=name or f"src{i}", times=times,
+                             timing_model=tm, template=dict(TPL),
+                             intervals=iv)
+
+
+def _reissue(spec, f0_bump=0.0):
+    """The same client returning with a (possibly nudged) ephemeris."""
+    tm = dict(spec.timing_model)
+    tm["F0"] = tm["F0"] + f0_bump
+    return survey.SourceSpec(name=spec.name, times=spec.times,
+                             timing_model=tm, template=dict(TPL),
+                             intervals=spec.intervals)
+
+
+def _assert_bitwise(frame, solo, ctx):
+    for col in survey.SURVEY_TOA_COLUMNS:
+        assert np.array_equal(frame[col].to_numpy(), solo[col].to_numpy()), \
+            (ctx, col)
+
+
+def _engine(**kw):
+    kw.setdefault("phShiftRes", 200)
+    return serve.ServingEngine(**kw)
+
+
+# ---------------------------------------------------------------------------
+# admission
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_capacity_knob(self, monkeypatch):
+        assert serve.queue_capacity() == 64
+        monkeypatch.setenv("CRIMP_TPU_SERVE_QUEUE", "3")
+        assert serve.queue_capacity() == 3
+        monkeypatch.setenv("CRIMP_TPU_SERVE_QUEUE", "0")
+        with pytest.raises(ValueError):
+            serve.queue_capacity()
+        monkeypatch.setenv("CRIMP_TPU_SERVE_QUEUE", "lots")
+        with pytest.raises(ValueError):
+            serve.queue_capacity()
+
+    def test_full_queue_is_typed_backpressure(self):
+        rng = np.random.RandomState(0)
+        q = AdmissionQueue(capacity=2)
+        q.offer(TimingRequest(spec=_make_spec(0, rng)))
+        q.offer(TimingRequest(spec=_make_spec(1, rng)))
+        with pytest.raises(AdmissionRejected) as e:
+            q.offer(TimingRequest(spec=_make_spec(2, rng)))
+        assert e.value.kind is FailureKind.RESOURCE_EXHAUSTED
+        assert taxonomy.classify(e.value) is FailureKind.RESOURCE_EXHAUSTED
+        assert len(q) == 2 and q.admitted == 2 and q.rejected == 1
+        # draining frees capacity: backpressure, not a permanent refusal
+        assert len(q.drain()) == 2
+        q.offer(TimingRequest(spec=_make_spec(2, rng)))
+
+    def test_malformed_requests_are_data_errors(self):
+        rng = np.random.RandomState(0)
+        q = AdmissionQueue(capacity=4)
+        with pytest.raises(AdmissionRejected) as e:
+            q.offer("not a request")
+        assert e.value.kind is FailureKind.DATA_ERROR
+        good = _make_spec(0, rng)
+        nameless = survey.SourceSpec(name="", times=good.times,
+                                     timing_model=good.timing_model,
+                                     template=good.template,
+                                     intervals=good.intervals)
+        with pytest.raises(AdmissionRejected) as e:
+            q.offer(TimingRequest(spec=nameless))
+        assert e.value.kind is FailureKind.DATA_ERROR
+        with pytest.raises(AdmissionRejected) as e:
+            q.offer(TimingRequest(spec=_make_spec(1, rng), deadline_s=-1.0))
+        assert e.value.kind is FailureKind.DATA_ERROR
+
+    def test_injected_admission_fault_rejects_classified(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "device:serve_admission:1")
+        faultinject.reset()
+        rng = np.random.RandomState(0)
+        q = AdmissionQueue(capacity=4)
+        with pytest.raises(AdmissionRejected) as e:
+            q.offer(TimingRequest(spec=_make_spec(0, rng)))
+        assert e.value.kind is FailureKind.DEVICE_LOST
+        # one-shot fault disarmed: the retry is admitted
+        q.offer(TimingRequest(spec=_make_spec(0, rng)))
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+class TestBreaker:
+    def test_threshold_knob(self, monkeypatch):
+        assert breaker_mod.breaker_threshold() == 5
+        monkeypatch.setenv("CRIMP_TPU_SERVE_BREAKER", "2")
+        assert breaker_mod.breaker_threshold() == 2
+        monkeypatch.setenv("CRIMP_TPU_SERVE_BREAKER", "no")
+        with pytest.raises(ValueError):
+            breaker_mod.breaker_threshold()
+
+    def test_full_cycle_is_deterministic_in_calls(self):
+        b = serve.RungBreakers(threshold=2, cooldown_calls=3)
+        assert b.allow("batched")
+        b.record_failure("batched", FailureKind.DEVICE_LOST)
+        assert b.state("batched") == breaker_mod.CLOSED  # 1 < threshold
+        b.record_failure("batched", FailureKind.DEVICE_LOST)
+        assert b.state("batched") == breaker_mod.OPEN
+        # cooldown counted in denied calls, no wall clock involved
+        assert not b.allow("batched")
+        assert not b.allow("batched")
+        assert b.allow("batched")  # 3rd denial -> half-open, probe admitted
+        assert b.state("batched") == breaker_mod.HALF_OPEN
+        assert not b.allow("batched")  # one probe at a time
+        b.record_failure("batched", FailureKind.RESOURCE_EXHAUSTED)
+        assert b.state("batched") == breaker_mod.OPEN  # probe failed
+        assert b.last_kind("batched") is FailureKind.RESOURCE_EXHAUSTED
+        for _ in range(3):
+            b.allow("batched")
+        assert b.state("batched") == breaker_mod.HALF_OPEN
+        b.record_success("batched")
+        assert b.state("batched") == breaker_mod.CLOSED
+        assert b.last_kind("batched") is None
+
+    def test_success_resets_failure_streak(self):
+        b = serve.RungBreakers(threshold=2, cooldown_calls=1)
+        b.record_failure("batched", FailureKind.TIMEOUT)
+        b.record_success("batched")
+        b.record_failure("batched", FailureKind.TIMEOUT)
+        assert b.state("batched") == breaker_mod.CLOSED  # streak broken
+
+    def test_zero_threshold_disables(self):
+        b = serve.RungBreakers(threshold=0)
+        for _ in range(50):
+            b.record_failure("batched", FailureKind.DEVICE_LOST)
+            assert b.allow("batched")
+
+    def test_rungs_are_independent(self):
+        b = serve.RungBreakers(threshold=1, cooldown_calls=8)
+        b.record_failure("batched", FailureKind.DEVICE_LOST)
+        assert not b.allow("batched")
+        assert b.allow("split_bucket")
+
+
+# ---------------------------------------------------------------------------
+# deadline scheduler
+# ---------------------------------------------------------------------------
+
+
+class TestScheduler:
+    def test_top_rung_when_unconstrained(self):
+        s = serve.DeadlineScheduler()
+        assert s.pick_rung(None) == ("batched", None)
+        assert s.pick_rung(10.0) == ("batched", None)
+
+    def test_preemptive_timeout_degrade(self):
+        s = serve.DeadlineScheduler()
+        s.observe("batched", 1.0)
+        s.observe("split_bucket", 0.01)
+        rung, forced = s.pick_rung(0.5)
+        assert rung == "split_bucket"
+        assert forced is FailureKind.TIMEOUT
+
+    def test_exhausted_budget_lands_on_bottom_rung(self):
+        s = serve.DeadlineScheduler()
+        s.observe("batched", 1.0)
+        s.observe("split_bucket", 1.0)
+        rung, forced = s.pick_rung(0.001)
+        assert rung == "per_source"
+        assert forced is FailureKind.TIMEOUT
+        # even a spent budget completes: the bottom rung is unconditional
+        assert s.pick_rung(-1.0)[0] == "per_source"
+
+    def test_breaker_shed_carries_its_kind(self):
+        s = serve.DeadlineScheduler()
+        b = serve.RungBreakers(threshold=1, cooldown_calls=99)
+        b.record_failure("batched", FailureKind.DEVICE_LOST)
+        rung, forced = s.pick_rung(None, b)
+        assert rung == "split_bucket"
+        assert forced is FailureKind.DEVICE_LOST
+
+    def test_ewma_tracks_recent_latency(self):
+        s = serve.DeadlineScheduler(alpha=0.5)
+        s.observe("batched", 1.0)
+        s.observe("batched", 0.0)
+        assert s.estimate("batched") == pytest.approx(0.5)
+        s.observe("batched", -5.0)  # nonsense sample ignored
+        assert s.estimate("batched") == pytest.approx(0.5)
+
+    def test_injected_deadline_fault_forces_bottom_rung(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "timeout:serve_deadline:1")
+        faultinject.reset()
+        s = serve.DeadlineScheduler()
+        rung, forced = s.pick_rung(10.0)
+        assert rung == "per_source"
+        assert forced is FailureKind.TIMEOUT
+
+
+# ---------------------------------------------------------------------------
+# the engine: continuous batching, parity, the delta hot path
+# ---------------------------------------------------------------------------
+
+
+class TestEngineParity:
+    def test_cold_batch_round_is_bitwise(self, obs_on):
+        rng = np.random.RandomState(11)
+        specs = [_make_spec(i, rng) for i in range(3)]
+        solos = [survey.measure_source_toas(s, phShiftRes=200)
+                 for s in specs]
+        deltafold.clear_cache()
+        eng = _engine()
+        with obs.run("serve_parity"):
+            for s in specs:
+                eng.submit(s)
+            results = eng.step()
+        assert [r.status for r in results] == ["ok"] * 3
+        assert [r.rung for r in results] == ["batched"] * 3
+        for r, solo, s in zip(results, solos, specs):
+            _assert_bitwise(r.frame, solo, s.name)
+
+    def test_warm_unchanged_retiming_hits_cache_bitwise(self, obs_on):
+        rng = np.random.RandomState(12)
+        specs = [_make_spec(i, rng) for i in range(2)]
+        solos = [survey.measure_source_toas(s, phShiftRes=200)
+                 for s in specs]
+        deltafold.clear_cache()
+        eng = _engine()
+        with obs.run("serve_warm"):
+            for s in specs:
+                eng.submit(s)
+            eng.step()
+            for s in specs:
+                eng.submit(_reissue(s))
+            warm = eng.step()
+        assert all(r.path == "delta_fold:cache" for r in warm)
+        for r, solo, s in zip(warm, solos, specs):
+            _assert_bitwise(r.frame, solo, s.name)
+
+    def test_perturbed_retiming_runs_as_delta_refold(self, obs_on):
+        rng = np.random.RandomState(13)
+        specs = [_make_spec(i, rng) for i in range(2)]
+        deltafold.clear_cache()
+        eng = _engine()
+        with obs.run("serve_delta"):
+            for s in specs:
+                eng.submit(s)
+            eng.step()
+            rec = obs.active()
+            before = dict(rec.counters)
+            for s in specs:
+                eng.submit(_reissue(s, f0_bump=1e-11))
+            warm = eng.step()
+            after = dict(rec.counters)
+        assert all(r.status == "ok" for r in warm)
+        assert all(r.path == "delta_fold:delta" for r in warm)
+        # the steady-state pin: refolds moved, exact folds did not
+        assert after.get("delta_fold_refolds", 0) - \
+            before.get("delta_fold_refolds", 0) == len(specs)
+        assert after.get("delta_fold_exact_folds", 0) == \
+            before.get("delta_fold_exact_folds", 0)
+
+    def test_multisource_off_uses_per_source_without_degrading(
+            self, monkeypatch, obs_on):
+        monkeypatch.setenv("CRIMP_TPU_MULTISOURCE", "0")
+        rng = np.random.RandomState(14)
+        spec = _make_spec(0, rng)
+        solo = survey.measure_source_toas(spec, phShiftRes=200)
+        deltafold.clear_cache()
+        eng = _engine()
+        with obs.run("serve_msoff"):
+            eng.submit(spec)
+            res = eng.step()
+        assert res[0].status == "ok"  # configured path, not a degradation
+        assert res[0].rung == "per_source"
+        _assert_bitwise(res[0].frame, solo, spec.name)
+        doc = load_manifest(obs.last_manifest_path())
+        assert not doc["degraded"]
+
+    def test_bad_spec_fails_classified_and_poisons_nothing(self, obs_on):
+        rng = np.random.RandomState(15)
+        good = _make_spec(0, rng)
+        solo = survey.measure_source_toas(good, phShiftRes=200)
+        bad = survey.SourceSpec(name="empty", times=np.zeros(0),
+                                timing_model={"PEPOCH": 58000.0, "F0": 0.1},
+                                template=dict(TPL),
+                                intervals=good.intervals)
+        deltafold.clear_cache()
+        eng = _engine()
+        with obs.run("serve_badspec"):
+            eng.submit(bad)
+            eng.submit(good)
+            res = eng.step()
+        by_id = {r.client_id: r for r in res}
+        assert by_id["empty"].status == "error"
+        assert by_id["empty"].kind == FailureKind.DATA_ERROR.value
+        assert by_id["empty"].error["kind"] == "data_error"
+        assert by_id[good.name].status == "ok"
+        _assert_bitwise(by_id[good.name].frame, solo, good.name)
+
+
+class TestDeadlines:
+    def test_preemptive_degrade_is_stamped(self, obs_on):
+        rng = np.random.RandomState(16)
+        deltafold.clear_cache()
+        eng = _engine()
+        # seed rung latency estimates: batched looks too slow for the
+        # budget, split_bucket fits
+        eng.scheduler.observe("batched", 5.0)
+        eng.scheduler.observe("split_bucket", 1e-4)
+        with obs.run("serve_deadline"):
+            eng.submit(_make_spec(0, rng), deadline_s=0.5)
+            res = eng.step()
+        assert res[0].status == "degraded"
+        assert res[0].rung == "split_bucket"
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["degraded"]
+        assert any(d.startswith("multisource:split_bucket:timeout")
+                   for d in doc["degradations"])
+        assert doc["counters"].get("serve_preemptive_degrades") == 1
+
+    def test_default_deadline_knob(self, monkeypatch):
+        assert scheduler_mod.default_deadline_s() is None
+        monkeypatch.setenv("CRIMP_TPU_SERVE_DEADLINE_MS", "1500")
+        assert scheduler_mod.default_deadline_s() == pytest.approx(1.5)
+        rng = np.random.RandomState(17)
+        eng = _engine()
+        req = eng.submit(_make_spec(0, rng))
+        assert req.deadline_s == pytest.approx(1.5)
+
+    def test_missed_deadline_still_completes(self, obs_on):
+        rng = np.random.RandomState(18)
+        deltafold.clear_cache()
+        eng = _engine()
+        with obs.run("serve_miss"):
+            eng.submit(_make_spec(0, rng), deadline_s=1e-9)
+            res = eng.step()
+        assert res[0].status in ("ok", "degraded")  # never an error
+        assert res[0].deadline_miss
+        assert res[0].frame is not None
+
+
+# ---------------------------------------------------------------------------
+# chaos: the serving contract under injected faults
+# ---------------------------------------------------------------------------
+
+
+def _assert_contract(results, rejected_ok=True):
+    """No fourth outcome, no unclassified error."""
+    kinds = {k.value for k in FailureKind}
+    for r in results:
+        assert r.status in ("ok", "degraded", "error"), r
+        if r.status == "error":
+            assert r.kind in kinds, r
+            assert r.error["kind"] in kinds
+
+
+class TestChaos:
+    def test_dispatch_faults_degrade_every_request(self, monkeypatch,
+                                                   obs_on):
+        # DEVICE_LOST then RESOURCE_EXHAUSTED at the dispatch point: the
+        # batched rung fails, the ladder absorbs it, every request
+        # completes
+        monkeypatch.setenv("CRIMP_TPU_FAULTS",
+                           "device:serve_dispatch:1,oom:serve_dispatch:2")
+        faultinject.reset()
+        rng = np.random.RandomState(19)
+        specs = [_make_spec(i, rng) for i in range(3)]
+        solos = [survey.measure_source_toas(s, phShiftRes=200)
+                 for s in specs]
+        deltafold.clear_cache()
+        eng = _engine()
+        with obs.run("serve_chaos1"):
+            for s in specs:
+                eng.submit(s)
+            res = eng.step()
+        _assert_contract(res)
+        assert all(r.status in ("ok", "degraded") for r in res)
+        assert any(r.status == "degraded" for r in res)
+        # degraded, not different: the per-source floor is parity-pinned
+        for r, solo, s in zip(res, solos, specs):
+            _assert_bitwise(r.frame, solo, s.name)
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["degraded"]
+        assert doc["counters"]["serve_degraded"] >= 1
+
+    def test_breaker_cycle_lands_in_manifest(self, monkeypatch, obs_on):
+        # a PERSISTENT dispatch fault (n+ form) trips the batched rung's
+        # breaker; clearing the fault lets the half-open probe close it —
+        # the full cycle, deterministic in call counts
+        rng = np.random.RandomState(20)
+        deltafold.clear_cache()
+        eng = _engine(breakers=serve.RungBreakers(threshold=1,
+                                                  cooldown_calls=1))
+        with obs.run("serve_breaker"):
+            monkeypatch.setenv("CRIMP_TPU_FAULTS",
+                               "device:serve_dispatch:1+")
+            faultinject.reset()
+            eng.submit(_make_spec(0, rng))
+            r1 = eng.step()  # batched fails -> open; completes per_source
+            assert eng.breakers.state("batched") == breaker_mod.OPEN
+            eng.submit(_make_spec(1, rng))
+            r2 = eng.step()  # denial -> half-open; probe fails -> reopen
+            assert eng.breakers.state("batched") == breaker_mod.OPEN
+            monkeypatch.delenv("CRIMP_TPU_FAULTS")
+            faultinject.reset()
+            eng.submit(_make_spec(2, rng))
+            r3 = eng.step()  # half-open probe succeeds -> closed
+            assert eng.breakers.state("batched") == breaker_mod.CLOSED
+        _assert_contract(r1 + r2 + r3)
+        assert [r.status for r in r1 + r2] == ["degraded", "degraded"]
+        assert r3[0].status == "ok"
+        doc = load_manifest(obs.last_manifest_path())
+        c = doc["counters"]
+        assert c["serve_breaker_open_batched"] == 1
+        assert c["serve_breaker_half_open_batched"] == 2
+        assert c["serve_breaker_reopen_batched"] == 1
+        assert c["serve_breaker_close_batched"] == 1
+        # and the ledger classifies the run degraded: chaos rounds can
+        # never feed the green baseline
+        entry = ledger.entries_from_path(obs.last_manifest_path())[0]
+        assert entry["class"] == "degraded"
+
+    def test_loadgen_chaos_holds_the_contract(self, monkeypatch, obs_on):
+        # all three serve points fault mid-load (device loss, OOM,
+        # timeout); open-loop load keeps arriving; the contract holds for
+        # every request and the run manifest records the carnage
+        monkeypatch.setenv(
+            "CRIMP_TPU_FAULTS",
+            "device:serve_dispatch:1,oom:serve_dispatch:3,"
+            "timeout:serve_deadline:2,oom:serve_admission:3")
+        faultinject.reset()
+        rng = np.random.RandomState(21)
+        base = [_make_spec(i, rng) for i in range(2)]
+        specs = [_reissue(base[i % 2], f0_bump=1e-12 * (i // 2))
+                 for i in range(8)]
+        deltafold.clear_cache()
+        eng = _engine(breakers=serve.RungBreakers(threshold=1,
+                                                  cooldown_calls=1))
+        with obs.run("serve_chaos2"):
+            summary = serve.run_load(eng, specs, rate_hz=200.0, seed=3,
+                                     deadline_s=30.0)
+        _assert_contract(summary["results"])
+        assert summary["completed"] + summary["rejected"] == len(specs)
+        assert summary["rejected"] >= 1  # the injected admission fault
+        assert summary["degraded"] >= 1  # the injected dispatch faults
+        assert summary["errors"] == 0   # every admitted request completed
+        assert summary["requests_per_s"] > 0
+        assert summary["p99_latency_ms"] >= summary["p50_latency_ms"]
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["degraded"]
+        assert doc["counters"]["serve_rejected"] >= 1
+        assert ledger.entries_from_path(
+            obs.last_manifest_path())[0]["class"] == "degraded"
+
+
+# ---------------------------------------------------------------------------
+# load generator
+# ---------------------------------------------------------------------------
+
+
+class TestLoadgen:
+    def test_poisson_arrivals_deterministic_and_increasing(self):
+        a = serve.poisson_arrivals(5.0, 100, seed=7)
+        b = serve.poisson_arrivals(5.0, 100, seed=7)
+        assert np.array_equal(a, b)
+        assert np.all(np.diff(a) > 0)
+        assert np.mean(np.diff(a)) == pytest.approx(0.2, rel=0.5)
+        with pytest.raises(ValueError):
+            serve.poisson_arrivals(0.0, 10)
+        with pytest.raises(ValueError):
+            serve.poisson_arrivals(5.0, 0)
+
+    def test_overload_rejections_are_measured_not_raised(self, obs_on):
+        import time as time_mod
+
+        rng = np.random.RandomState(22)
+        specs = [_make_spec(i, rng, n_per=30) for i in range(6)]
+        deltafold.clear_cache()
+        eng = _engine(queue=AdmissionQueue(capacity=1))
+
+        real_step = eng.step
+        t_hold = time_mod.perf_counter() + 0.25
+
+        def slow_drain():
+            # hold the queue full past every scheduled arrival so the
+            # 1-deep queue overflows (all arrivals land within ~15 ms)
+            if time_mod.perf_counter() < t_hold:
+                return []
+            return real_step()
+
+        eng.step = slow_drain
+        with obs.run("serve_overload"):
+            summary = serve.run_load(eng, specs, rate_hz=500.0, seed=1)
+        assert summary["rejected"] >= 1
+        assert summary["completed"] + summary["rejected"] == len(specs)
+        _assert_contract(summary["results"])
+
+
+# ---------------------------------------------------------------------------
+# off-path inertness
+# ---------------------------------------------------------------------------
+
+
+class TestOffPath:
+    def test_batch_pipeline_unchanged_by_serving_traffic(self, obs_on):
+        # the same survey call is bit-identical before and after the
+        # engine has served traffic: serving seeds its own cache slots
+        # but never mutates the batch pipeline's inputs or config
+        rng = np.random.RandomState(23)
+        specs = [_make_spec(i, rng) for i in range(2)]
+        before = [survey.measure_source_toas(s, phShiftRes=200)
+                  for s in specs]
+        eng = _engine()
+        for s in specs:
+            eng.submit(_reissue(s))
+        eng.step()
+        after = [survey.measure_source_toas(s, phShiftRes=200)
+                 for s in specs]
+        for s, fa, fb in zip(specs, before, after):
+            _assert_bitwise(fa, fb, s.name)
+
+    def test_serve_knobs_unread_off_path(self, monkeypatch):
+        # a malformed serve knob must not break batch pipelines (one
+        # registry read happens only when serving code runs)
+        monkeypatch.setenv("CRIMP_TPU_SERVE_QUEUE", "garbage")
+        rng = np.random.RandomState(24)
+        survey.measure_source_toas(_make_spec(0, rng), phShiftRes=200)
