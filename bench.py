@@ -72,6 +72,17 @@ DELTA_FOLD_DEV_FRAC = 0.01  # fraction of the 1 us per-ToA error bar
 # multisource=1 for the workload bucket.
 MULTISOURCE_SPEEDUP_GATE = 2.0
 
+# Promotion gate for the delta-basis MCMC engine (ops/mcmc.py +
+# pipelines/fit_toas.py): the matmul likelihood must beat the exact
+# likelihood by >2x in effective samples per second AND its 16/50/84
+# posterior quantiles must agree with the exact chain within the
+# Monte-Carlo error of the chains themselves (in units of
+# posterior_std/sqrt(ESS)) AND the exact engine must be bit-stable
+# across repeat runs at a fixed seed. Only then does bench persist
+# mcmc_delta=1 for the n_toas bucket.
+MCMC_DELTA_SPEEDUP_GATE = 2.0
+MCMC_QUANTILE_SIGMA_GATE = 5.0  # quantile agreement, in MC-error sigmas
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -722,6 +733,156 @@ def bench_delta_fold(par_path: str, times: np.ndarray, intervals,
     return out
 
 
+def bench_mcmc(par_path: str, times: np.ndarray, steps: int = 500,
+               burn: int = 100, walkers: int = 32, n_toas: int = 800,
+               persist: bool = True) -> dict:
+    """Exact-vs-delta posterior engine A/B with the ESS/s promotion gate.
+
+    The workload is a config-3-shaped single-source glitch fit: a
+    glitch-bearing synthetic model (same glitch layout as
+    bench_delta_fold, so the exact likelihood pays the per-proposal
+    Taylor+glitch+exp evaluation a magnetar fit pays) with six linear
+    free parameters, sampled by both engines from the SAME initial
+    ensemble and PRNG key. The headline is effective samples per second
+    — raw wall speed means nothing if the chain mixes worse — and the
+    gate demands >2x ESS/s AND 16/50/84 quantile agreement within the
+    chains' own Monte-Carlo error AND a bit-stable exact engine. The
+    gated winner persists through autotune.store_mcmc_delta for the
+    n_toas bucket (resolve_mcmc_delta's cached rung)."""
+    import jax
+
+    from crimp_tpu.io.yamlcfg import Prior
+    from crimp_tpu.models import timing
+    from crimp_tpu.ops import autotune
+    from crimp_tpu.ops import mcmc as mcmc_ops
+    from crimp_tpu.pipelines import fit_toas, fit_utils
+
+    tm0 = timing.resolve(par_path)
+    f = np.asarray(tm0.f, dtype=np.float64)
+    lo_t, hi_t = float(times.min()), float(times.max())
+    base = {"PEPOCH": float(np.asarray(tm0.pepoch)),
+            "F0": float(f[0]), "F1": float(f[1]), "F2": float(f[2]),
+            "GLEP_1": lo_t + (hi_t - lo_t) / 3.0, "GLPH_1": 1e-3,
+            "GLF0_1": 1e-7, "GLF1_1": -1e-15, "GLF0D_1": 5e-8,
+            "GLTD_1": 50.0,
+            "GLEP_2": lo_t + 2.0 * (hi_t - lo_t) / 3.0, "GLF0_2": 5e-8}
+    keys = ["F0", "F1", "GLPH_1", "GLF0_1", "GLF0D_1", "GLF0_2"]
+    parfile = {k: {"value": np.float64(v), "flag": int(k in keys)}
+               for k, v in base.items()}
+    widths = {"F0": 1e-8, "F1": 1e-16, "GLPH_1": 5e-4, "GLF0_1": 2e-9,
+              "GLF0D_1": 2e-9, "GLF0_2": 2e-9}
+    prior = Prior(bounds={k: (-w, w) for k, w in widths.items()},
+                  initial_guess={})
+
+    rng = np.random.default_rng(11)
+    t = np.sort(rng.uniform(lo_t, hi_t, n_toas))
+    truth = np.array([0.3 * widths[k] for k in keys])
+    sigma = 0.01  # cycles
+    y = fit_utils.model_phase_residuals(t, parfile, truth, keys) \
+        + rng.normal(0.0, sigma, n_toas)
+    yerr = np.full(n_toas, sigma)
+
+    ndim = len(keys)
+    out: dict = {"n_toas": n_toas, "steps": steps, "walkers": walkers,
+                 "ndim": ndim, "speedup_gate": MCMC_DELTA_SPEEDUP_GATE,
+                 "quantile_sigma_gate": MCMC_QUANTILE_SIGMA_GATE}
+    budget = autotune.DELTA_FOLD_BUDGET_DEFAULT
+    data, info = fit_toas.make_logprob_delta(
+        parfile, keys, prior, t, y, yerr, budget=budget)
+    out["guard"] = {k: info.get(k) for k in
+                    ("eligible", "reason", "bound_cycles", "budget_cycles")}
+    if data is None:
+        # the guard refusing its OWN bench workload is a result, not an
+        # error: record it, never promote
+        out.update(promoted=False, persisted=False, ess_per_s=None)
+        log(f"[bench] mcmc: guard refused the delta path "
+            f"({info.get('reason')}); nothing to promote")
+        return out
+    exact_fn, exact_data = fit_toas.make_logprob_parts(
+        parfile, keys, prior, t, y, yerr)
+
+    # same initial ensemble + key construction as fit_toas.run_mcmc(seed=0),
+    # so the A/B measures exactly what a promoted pipeline run would do
+    p_rng = np.random.default_rng(0)
+    p0 = np.empty((walkers, ndim))
+    for i, name in enumerate(keys):
+        lo_b, hi_b = prior.bounds[name]
+        p0[:, i] = p_rng.uniform(lo_b, hi_b, size=walkers)
+    key = jax.random.PRNGKey(0)
+
+    def run(fn, d):
+        c, lp = mcmc_ops.ensemble_sample(fn, p0, steps, key, data=d)
+        return np.asarray(c), np.asarray(lp)
+
+    run(mcmc_ops.delta_logprob, data)  # compile/warm the delta engine
+    t0 = time.perf_counter()
+    c_delta, _ = run(mcmc_ops.delta_logprob, data)
+    wall_delta = time.perf_counter() - t0
+
+    run(exact_fn, exact_data)  # compile/warm the exact engine
+    t0 = time.perf_counter()
+    c_exact, _ = run(exact_fn, exact_data)
+    wall_exact = time.perf_counter() - t0
+
+    # same seed, same engine -> the exact chain must be bit-stable (the
+    # knob-off contract run_mcmc inherits)
+    c_exact2, _ = run(exact_fn, exact_data)
+    out["off_bitwise_identical"] = bool(np.array_equal(c_exact, c_exact2))
+
+    ess_delta = np.asarray(mcmc_ops.effective_sample_size(c_delta[burn:]))
+    ess_exact = np.asarray(mcmc_ops.effective_sample_size(c_exact[burn:]))
+    ess_s_delta = float(ess_delta.min()) / wall_delta
+    ess_s_exact = float(ess_exact.min()) / wall_exact
+    out["wall_s_delta"] = round(wall_delta, 4)
+    out["wall_s_exact"] = round(wall_exact, 4)
+    out["ess_min_delta"] = float(ess_delta.min())
+    out["ess_min_exact"] = float(ess_exact.min())
+    out["ess_per_s_delta"] = ess_s_delta
+    out["ess_per_s_exact"] = ess_s_exact
+
+    # 16/50/84 agreement in units of each dimension's own MC error
+    # (posterior std / sqrt(ESS), the conservative per-quantile scale)
+    flat_d = c_delta[burn:].reshape(-1, ndim)
+    flat_e = c_exact[burn:].reshape(-1, ndim)
+    dev_sigmas = 0.0
+    for d in range(ndim):
+        mc_err = flat_e[:, d].std() / np.sqrt(
+            min(ess_delta[d], ess_exact[d]))
+        q_d = np.percentile(flat_d[:, d], [16, 50, 84])
+        q_e = np.percentile(flat_e[:, d], [16, 50, 84])
+        dev_sigmas = max(dev_sigmas,
+                         float(np.max(np.abs(q_d - q_e)) / mc_err))
+    out["quantile_dev_sigmas"] = dev_sigmas
+    log(f"[bench] mcmc: exact {ess_s_exact:.1f} vs delta {ess_s_delta:.1f} "
+        f"ESS/s (x{ess_s_delta / ess_s_exact:.1f}), quantile dev "
+        f"{dev_sigmas:.2f} MC-sigma")
+
+    promoted = bool(
+        ess_s_delta > MCMC_DELTA_SPEEDUP_GATE * ess_s_exact
+        and dev_sigmas < MCMC_QUANTILE_SIGMA_GATE
+        and out["off_bitwise_identical"]
+    )
+    out["promoted"] = promoted
+    # the ledger headline is the rate of the path a promoted (or not)
+    # pipeline run would actually take
+    out["ess_per_s"] = ess_s_delta if promoted else ess_s_exact
+    out["persisted"] = False
+    if persist:
+        try:
+            autotune.store_mcmc_delta(n_toas, {
+                "mcmc_delta": int(promoted), "budget": budget,
+                "ess_per_s_exact": round(ess_s_exact, 1),
+                "ess_per_s_delta": round(ess_s_delta, 1),
+            })
+            out["persisted"] = True
+        except Exception as exc:  # noqa: BLE001 - persistence is best-effort
+            log(f"[bench] mcmc winner not persisted: {exc}")
+    log(f"[bench] mcmc gate: promoted={promoted} "
+        f"(>{MCMC_DELTA_SPEEDUP_GATE}x ESS/s + quantiles within "
+        f"{MCMC_QUANTILE_SIGMA_GATE} MC-sigma + exact engine bit-stable)")
+    return out
+
+
 def bench_multisource(batch_sizes=(16, 64, 128), n_int: int = 4,
                       events_per_int: int = 300, persist: bool = True) -> dict:
     """Survey batch engine A/B: vmapped multi-source fold+H vs the
@@ -1275,7 +1436,7 @@ def main():
 
     errors: dict[str, str] = {}
     # the step() call sites below, in order — heartbeat denominators
-    n_stages = 9  # surrogate warmup z2 grid_mxu delta_fold multisource toas north_star config4
+    n_stages = 10  # surrogate warmup z2 grid_mxu delta_fold mcmc multisource toas north_star config4
     stages_done = [0]
 
     def step(name: str, fn, *args, **kwargs):
@@ -1336,6 +1497,9 @@ def main():
                     n_trials=z2_trials, n_fdot=4 if on_cpu else 8)
 
     delta_fold = step("delta_fold", bench_delta_fold, par, times, intervals)
+
+    mcmc_ab = step("mcmc", bench_mcmc, par, times,
+                   steps=scaled(500, 120), n_toas=scaled(800, 200))
 
     ms = step("multisource", bench_multisource,
               events_per_int=scaled(100 if on_cpu else 300, 40))
@@ -1424,6 +1588,17 @@ def main():
         # gate (>2x + deviation under 1% of the per-ToA error bar + off
         # path bit-stable); the gated winner persists in the autotune cache
         "delta_fold_ab": delta_fold,
+        # exact-vs-delta posterior engine A/B (ops/mcmc.py delta_logprob)
+        # with its promotion gate (>2x effective samples per second +
+        # 16/50/84 quantiles within the chains' MC error + bit-stable
+        # exact engine); the gated winner persists in the autotune cache.
+        # ess_per_s (the surviving path's rate) joins the ledger's
+        # green-baseline gating (obs/ledger.py METRICS).
+        "mcmc_ab": mcmc_ab,
+        "ess_per_s": (
+            round(mcmc_ab["ess_per_s"], 1)
+            if mcmc_ab and mcmc_ab.get("ess_per_s") else None
+        ),
         # survey batch engine A/B (ops/multisource.py): vmapped batched
         # fold+H vs the per-source loop at several batch sizes, bitwise
         # parity asserted; the gated verdict persists in the autotune

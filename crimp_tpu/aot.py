@@ -129,11 +129,15 @@ def warmup(
         log_prob_fn = spec.get(
             "log_prob_fn", lambda p: -0.5 * jnp.sum(p * p)
         )
+        # the jitted core behind ensemble_sample: log_prob_fn/steps are
+        # static, observations (``data``, None for the closure form) and
+        # stretch_a travel traced — same avals as the runtime wrapper
         _lower_compile(
             report, "ensemble_sample",
-            mcmc_mod.ensemble_sample, log_prob_fn,
+            mcmc_mod._ensemble_core, log_prob_fn,
             jax.ShapeDtypeStruct((walkers, ndim), jnp.float64),
-            steps, jax.random.PRNGKey(0),
+            spec.get("data") if isinstance(mcmc, dict) else None,
+            steps, jax.random.PRNGKey(0), 2.0,
         )
 
     after = profiling.compile_counters()

@@ -102,6 +102,10 @@ REGISTRY: dict[str, Knob] = _build_registry((
     Knob("CRIMP_TPU_DELTA_FOLD_BUDGET", "1e-9 cycles", "float",
          numeric_key="delta_fold", consumer="ops/deltafold.py via ops/autotune.py",
          doc="delta-fold precision-guard budget"),
+    Knob("CRIMP_TPU_MCMC_DELTA", "unset (off unless a tuner winner)", "int",
+         numeric_key="mcmc_delta",
+         consumer="pipelines/fit_toas.py via ops/autotune.py",
+         doc="delta-basis MCMC likelihood (batched-matmul proposals) on/off"),
     # -- throughput / caching (bit-identical by construction) ---------------
     Knob("CRIMP_TPU_SHARD", "auto", "bool", consumer="parallel/mesh.py",
          doc="multi-chip auto-sharding opt-out (mesh-shape invariance is pinned by tests)"),

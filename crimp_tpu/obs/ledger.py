@@ -65,6 +65,7 @@ METRICS: dict[str, dict] = {
     "config4_toas_per_sec": {"field": "config4_toas_per_sec",
                              "better": "higher"},
     "sources_per_s": {"field": "sources_per_s", "better": "higher"},
+    "ess_per_s": {"field": "ess_per_s", "better": "higher"},
     "warmup_s": {"field": "warmup_s", "better": "lower"},
     "backend_compile_s": {"field": ("compile_cache", "backend_compile_s"),
                           "better": "lower"},

@@ -498,6 +498,85 @@ def resolve_delta_fold(n_events: int) -> dict:
     return out
 
 
+# -- delta-basis MCMC knob --------------------------------------------------
+#
+# CRIMP_TPU_MCMC_DELTA switches the ensemble sampler's likelihood
+# (pipelines/fit_toas.py run_mcmc) between the exact per-proposal phase
+# evaluation and the delta-basis path, where a proposal's residuals are
+# one B @ dp matmul against the per-run precomputed delta-fold basis.
+# Like delta_fold the switch is accuracy-gated: only bench.py's
+# ESS/second + posterior-quantile-checked bench_mcmc A/B ever caches a 1,
+# and the env var stays a hard override in both directions. The entry
+# reuses the delta-fold precision budget (cycles) that the host-side
+# guard enforces over the walker prior-box extent before admitting the
+# linear path; CRIMP_TPU_DELTA_FOLD_BUDGET overrides it. The cache key
+# uses the kernel name "mcmc_delta_enable" so the entry can never collide
+# with the delta_fold or block-size entries.
+
+MCMC_DELTA_ENV = "CRIMP_TPU_MCMC_DELTA"
+
+
+def mcmc_delta_defaults() -> dict:
+    return {"mcmc_delta": 0, "budget": DELTA_FOLD_BUDGET_DEFAULT}
+
+
+def mcmc_delta_cache_key(n_toas: int,
+                         platform: str | None = None,
+                         device_kind: str | None = None) -> str:
+    return cache_key("mcmc_delta_enable", False, n_toas, 1,
+                     platform=platform, device_kind=device_kind)
+
+
+def cached_mcmc_delta(n_toas: int) -> dict | None:
+    entry = _load_cache().get(mcmc_delta_cache_key(n_toas))
+    if not isinstance(entry, dict):
+        return None
+    d, b = entry.get("mcmc_delta"), entry.get("budget")
+    if d in (0, 1) and isinstance(b, (int, float)) and 0.0 < b < float("inf"):
+        return {"mcmc_delta": d, "budget": float(b)}
+    return None
+
+
+def store_mcmc_delta(n_toas: int, entry: dict,
+                     path: pathlib.Path | None = None) -> None:
+    """Persist a gated delta-basis MCMC A/B winner (bench.py calls this)."""
+    _store_entry(mcmc_delta_cache_key(n_toas), entry, path)
+
+
+def resolve_mcmc_delta(n_toas: int) -> dict:
+    """Resolve {mcmc_delta, budget} for an n_toas posterior fit.
+
+    Precedence per knob: CRIMP_TPU_MCMC_DELTA / CRIMP_TPU_DELTA_FOLD_BUDGET
+    (hard overrides in both directions, honored even with autotune off;
+    malformed raises) > cached A/B winner (unless CRIMP_TPU_AUTOTUNE=0) >
+    default off with DELTA_FOLD_BUDGET_DEFAULT. Never times anything —
+    the A/B with its ESS/s and posterior-quantile gates lives in bench.py
+    (bench_mcmc), exactly like the delta_fold discipline. The exact
+    likelihood stays the default, so an untouched install samples
+    bit-identically to the pre-engine code path.
+    """
+    out = mcmc_delta_defaults()
+    env_d = _env_nonneg_int(MCMC_DELTA_ENV, valid=(0, 1))
+    env_b = _env_pos_float(DELTA_FOLD_BUDGET_ENV)
+    if autotune_mode() != "off":
+        try:
+            cached = cached_mcmc_delta(n_toas)
+        except Exception as exc:  # noqa: BLE001 — a corrupt cache or an
+            # uninitializable backend must never take down a posterior fit
+            logger.warning("mcmc_delta autotune cache lookup failed (%s); "
+                           "using static defaults",
+                           resilience.classify(exc).value, exc_info=True)
+            cached = None
+        _count_cache(bool(cached))
+        if cached:
+            out.update(cached)
+    if env_d is not None:
+        out["mcmc_delta"] = env_d
+    if env_b is not None:
+        out["budget"] = env_b
+    return out
+
+
 # -- multisource survey engine knob -----------------------------------------
 #
 # CRIMP_TPU_MULTISOURCE switches pipelines/survey.py between the vmapped

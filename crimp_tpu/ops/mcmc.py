@@ -9,6 +9,26 @@ instead of 320k Python-loop model evaluations.
 
 Ensemble halves update alternately (the standard parallel-stretch scheme
 emcee also uses), keeping detailed balance while staying fully batched.
+
+Two things make this file the survey-scale posterior engine:
+
+- **Compile stability.** The jitted cores take the observation set as a
+  traced pytree argument (``data``) and the log-probability as a STATIC
+  function of ``(theta, data)``. A caller that passes a stable
+  module-level function — ``delta_logprob`` below, or the cached exact
+  likelihood in pipelines/fit_toas.py — hits the same compiled executable
+  on every run at the same shapes. (The old API closed the data over a
+  fresh ``log_prob_fn`` per run, so ``static_argnames`` retraced every
+  single ``run_mcmc`` call.)
+
+- **The delta-basis likelihood.** ``delta_logprob`` scores a proposal as
+  ``resid = y - center(basis @ theta)`` — within the linear regime of the
+  delta parameterization (ops/deltafold.py) a proposal's model residuals
+  are exactly one ``B @ dp`` product, so a vmapped half-ensemble update is
+  a single ``(walkers x ndim) @ (ndim x nToA)`` matmul instead of a full
+  Taylor+glitch+wave phase evaluation per walker. The masked form also
+  serves padded multi-problem batches: padding rows carry ``mask == 0``
+  and contribute exactly ``+0.0`` to the log-probability.
 """
 
 from __future__ import annotations
@@ -20,53 +40,112 @@ import jax.numpy as jnp
 import numpy as np
 
 
-@partial(jax.jit, static_argnames=("log_prob_fn", "steps"))
+def delta_logprob(theta, data):
+    """Linear-regime Gaussian log-probability: ``mu = basis @ theta``.
+
+    ``data`` is a pytree dict with keys ``basis`` (N, ndim), ``y`` (N,),
+    ``err`` (N,), ``mask`` (N,), ``lo``/``hi`` (ndim,). The model is
+    mean-subtracted over the valid (mask == 1) rows and compared against
+    the (already centered) data vector; rows with ``mask == 0`` are inert
+    padding and contribute exactly +0.0 to the sum. Box priors gate the
+    result to -inf outside [lo, hi].
+
+    This one module-level function is the whole delta-basis MCMC
+    likelihood: single-source fits (pipelines/fit_toas.py, mask all-ones),
+    sliding-window batches (pipelines/local_ephem.py), and the stacked
+    multi-source mode (ops/multisource.py) all pass it to the samplers
+    below with their own ``data`` pytrees, so they share one compiled
+    ensemble core per shape family.
+    """
+    basis, y, err, mask, lo, hi = (
+        data["basis"], data["y"], data["err"], data["mask"], data["lo"],
+        data["hi"],
+    )
+    in_box = jnp.all((theta > lo) & (theta < hi))
+    mu = basis @ theta
+    mu = mu - jnp.sum(mu * mask) / jnp.sum(mask)
+    resid = (y - mu) / err
+    nll = 0.5 * jnp.sum(mask * (resid**2 + jnp.log(2 * jnp.pi * err**2)))
+    return jnp.where(in_box, -nll, -jnp.inf)
+
+
 def ensemble_sample(
     log_prob_fn,
     p0: jax.Array,  # (walkers, ndim) initial ensemble
     steps: int,
     key: jax.Array,
     stretch_a: float = 2.0,
+    data=None,
 ):
     """Run the stretch-move ensemble; returns (chain, log_probs).
 
     chain: (steps, walkers, ndim); log_probs: (steps, walkers).
+
+    With ``data`` (a pytree of observations) the log-probability is called
+    as ``log_prob_fn(theta, data)`` and the compiled core is reused across
+    calls whenever ``log_prob_fn`` is a stable (module-level or cached)
+    function — the data arrays are traced arguments, not baked-in
+    constants. Without ``data`` the legacy single-argument closure form
+    still works, at the cost of a retrace per distinct closure.
     """
-    return _ensemble_core(log_prob_fn, p0, steps, key, stretch_a)
+    return _ensemble_core(log_prob_fn, p0, data, steps, key, stretch_a)
 
 
-@partial(jax.jit, static_argnames=("log_prob_fn", "steps"))
 def ensemble_sample_batch(
     log_prob_fn,
     p0: jax.Array,  # (B, walkers, ndim) per-problem initial ensembles
     data,  # pytree with leading axis B: per-problem observations
     steps: int,
-    key: jax.Array,
+    key: jax.Array = None,
     stretch_a: float = 2.0,
+    keys: jax.Array = None,
 ):
     """Independent ensembles vmapped over a batch of problems.
 
     ``log_prob_fn(theta, data_b)`` scores one walker of problem b. This is
     the vmap-over-windows device program of SURVEY §3.5 (the reference runs
-    one emcee per sliding window, get_local_ephem.py:104-239): every
-    window/segment samples in parallel in ONE compiled call. Returns
-    (chain (B, steps, walkers, ndim), log_probs (B, steps, walkers)).
+    one emcee per sliding window, get_local_ephem.py:104-239) and the
+    source axis of the multisource posterior mode (ops/multisource.py):
+    every window/segment/source samples in parallel in ONE compiled call.
+
+    Pass either ``key`` (split into one subkey per problem, the classic
+    form) or pre-split per-problem ``keys`` (B, 2) — the latter lets a
+    caller chunk a large batch over several dispatches while keeping every
+    problem's random stream identical to the unchunked run.
+
+    Returns (chain (B, steps, walkers, ndim), log_probs (B, steps, walkers)).
     """
-    n_batch = p0.shape[0]
-    keys = jax.random.split(key, n_batch)
+    if keys is None:
+        keys = jax.random.split(key, p0.shape[0])
+    return _ensemble_batch_core(log_prob_fn, p0, data, steps, keys, stretch_a)
 
+
+@partial(jax.jit, static_argnames=("log_prob_fn", "steps"))
+def _ensemble_core(log_prob_fn, p0, data, steps: int, key, stretch_a):
+    return _ensemble_scan(log_prob_fn, p0, data, steps, key, stretch_a)
+
+
+@partial(jax.jit, static_argnames=("log_prob_fn", "steps"))
+def _ensemble_batch_core(log_prob_fn, p0, data, steps: int, keys, stretch_a):
     def one(p0_b, data_b, key_b):
-        return _ensemble_core(
-            lambda theta: log_prob_fn(theta, data_b), p0_b, steps, key_b, stretch_a
-        )
+        return _ensemble_scan(log_prob_fn, p0_b, data_b, steps, key_b, stretch_a)
 
-    return jax.vmap(one)(p0, data, keys)
+    return jax.vmap(one, in_axes=(0, 0, 0))(p0, data, keys)
 
 
-def _ensemble_core(log_prob_fn, p0, steps: int, key, stretch_a: float):
+def _ensemble_scan(log_prob_fn, p0, data, steps: int, key, stretch_a):
+    # ``data is None`` is pytree STRUCTURE, so the branch is resolved at
+    # trace time: the legacy closure form and the threaded-data form each
+    # get their own cache entry, never a runtime conditional.
+    if data is None:
+        lp_fn = log_prob_fn
+    else:
+        def lp_fn(theta):
+            return log_prob_fn(theta, data)
+
     n_walkers, ndim = p0.shape
     half = n_walkers // 2
-    lp0 = jax.vmap(log_prob_fn)(p0)
+    lp0 = jax.vmap(lp_fn)(p0)
 
     def half_update(key, movers, movers_lp, others):
         k_part, k_z, k_accept = jax.random.split(key, 3)
@@ -76,7 +155,7 @@ def _ensemble_core(log_prob_fn, p0, steps: int, key, stretch_a: float):
         u = jax.random.uniform(k_z, (movers.shape[0],))
         z = ((stretch_a - 1.0) * u + 1.0) ** 2 / stretch_a
         proposal = partners + z[:, None] * (movers - partners)
-        prop_lp = jax.vmap(log_prob_fn)(proposal)
+        prop_lp = jax.vmap(lp_fn)(proposal)
         log_ratio = (ndim - 1) * jnp.log(z) + prop_lp - movers_lp
         accept = jnp.log(jax.random.uniform(k_accept, (movers.shape[0],))) < log_ratio
         new = jnp.where(accept[:, None], proposal, movers)
@@ -102,6 +181,12 @@ def _ensemble_core(log_prob_fn, p0, steps: int, key, stretch_a: float):
 def summarize_chain(chain: np.ndarray, log_probs: np.ndarray, keys: list[str], burn: int = 0):
     """Posterior summaries matching the reference's reporting
     (fit_toas.py:192-202): median, 16/84-percentile deviations, MAP."""
+    n_steps = chain.shape[0]
+    if burn >= n_steps:
+        raise ValueError(
+            f"burn ({burn}) must be smaller than the number of recorded "
+            f"steps ({n_steps}); nothing would be left to summarize"
+        )
     flat = chain[burn:].reshape(-1, chain.shape[-1])
     flat_lp = log_probs[burn:].reshape(-1)
     i_map = int(np.argmax(flat_lp))
@@ -115,3 +200,52 @@ def summarize_chain(chain: np.ndarray, log_probs: np.ndarray, keys: list[str], b
             "map": float(flat[i_map, i]),
         }
     return flat, flat_lp, summaries
+
+
+def effective_sample_size(chain: np.ndarray, c: float = 5.0) -> np.ndarray:
+    """Autocorrelation-time effective sample size (host-side numpy).
+
+    ``chain`` is (steps,), (steps, walkers) or (steps, walkers, ndim).
+    Per dimension, the normalized autocorrelation function is averaged
+    across walkers (each walker demeaned by the ensemble mean, the
+    standard emcee ``integrated_time`` construction), the integrated
+    autocorrelation time is ``tau = 1 + 2 * sum_{t>=1} rho(t)`` with
+    Sokal's automatic windowing (smallest M with M >= c * tau(M)), and
+    ESS = total samples / tau. Returns a scalar for 1-D/2-D input, an
+    (ndim,) vector for 3-D input. For an AR(1) chain with coefficient
+    rho the exact answer is tau = (1 + rho) / (1 - rho).
+    """
+    x = np.asarray(chain, dtype=np.float64)
+    if x.ndim == 1:
+        x = x[:, None]
+    if x.ndim == 2:
+        return float(_ess_one(x, c))
+    if x.ndim != 3:
+        raise ValueError(f"chain must be 1-D, 2-D or 3-D, got shape {x.shape}")
+    return np.array([_ess_one(x[:, :, d], c) for d in range(x.shape[2])])
+
+
+def _ess_one(x: np.ndarray, c: float) -> float:
+    """ESS for one (steps, walkers) scalar chain."""
+    n_steps, n_walkers = x.shape
+    total = n_steps * n_walkers
+    if n_steps < 2:
+        return float(total)
+    y = x - x.mean(axis=0, keepdims=True)
+    # FFT autocovariance per walker, averaged across the ensemble
+    n_fft = 1
+    while n_fft < 2 * n_steps:
+        n_fft *= 2
+    f = np.fft.rfft(y, n=n_fft, axis=0)
+    acov = np.fft.irfft(f * np.conjugate(f), n=n_fft, axis=0)[:n_steps].real
+    acov = acov.mean(axis=1) / n_steps
+    if acov[0] <= 0.0:
+        return float(total)  # constant chain: every sample identical
+    rho = acov / acov[0]
+    # Sokal window: cumulative tau, stop at the smallest M >= c * tau(M)
+    taus = 2.0 * np.cumsum(rho) - 1.0
+    window = np.arange(len(taus))
+    hit = np.nonzero(window >= c * taus)[0]
+    m = int(hit[0]) if hit.size else len(taus) - 1
+    tau = max(float(taus[m]), 1.0)
+    return float(total / tau)

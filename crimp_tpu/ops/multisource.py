@@ -452,3 +452,94 @@ def h_power_sources(seg_times_list, freqs_list, nharm: int = 5):
         row_block=chunk,
     ))
     return [h[s] for s in slices]
+
+
+# ---------------------------------------------------------------------------
+# Survey-scale posteriors: batched delta-basis MCMC across the source axis
+# ---------------------------------------------------------------------------
+
+
+def sample_posterior_sources(problems, steps: int, walkers: int,
+                             seed: int = 0, stretch_a: float = 2.0):
+    """Delta-basis ensemble MCMC for MANY sources in chunked batch dispatches.
+
+    ``problems`` is one dict per source with keys ``basis`` (n_i, ndim),
+    ``y`` (n_i,), ``err`` (n_i,), ``lo``/``hi`` (ndim,) — exactly the
+    ``mcmc.delta_logprob`` observation pytree, typically produced by
+    ``pipelines.fit_toas.make_logprob_delta`` (which also runs the
+    linear-regime precision guard; guard-tripped sources belong on the
+    single-source exact path, not in this batch). All sources must share
+    ``ndim``; ragged ToA counts pad to the batch max with INERT rows
+    (``mask == 0``) whose every log-probability TERM is exactly +0.0 —
+    padding never biases a posterior. Same contract as the ragged fold
+    buckets above: identical padded width reproduces bits exactly, but
+    changing the padded width may regroup the reduction's partial sums,
+    so a source re-run at a different width matches to float64
+    reduction-order tolerance (last-ulp), not bitwise.
+
+    Walker initialization draws uniformly inside each source's prior box
+    from ``np.random.default_rng(seed)`` spawned per source index, and the
+    per-source PRNG streams are pre-split from one master key — both are
+    functions of (seed, source index) alone, so results are invariant to
+    the source-block chunking ``_resolve_chunk`` picks.
+
+    Returns (chains (B, steps, walkers, ndim), log_probs (B, steps,
+    walkers)) as numpy arrays.
+    """
+    from crimp_tpu.ops import mcmc as mcmc_ops
+
+    if not problems:
+        return np.zeros((0, steps, walkers, 0)), np.zeros((0, steps, walkers))
+    ndims = {np.asarray(p["basis"]).shape[1] for p in problems}
+    if len(ndims) != 1:
+        raise ValueError(f"all sources must share ndim, got {sorted(ndims)}")
+    (ndim,) = ndims
+    B = len(problems)
+    n_max = max(np.asarray(p["basis"]).shape[0] for p in problems)
+
+    basis = np.zeros((B, n_max, ndim))
+    y = np.zeros((B, n_max))
+    err = np.ones((B, n_max))  # padded rows keep err=1 so log() stays finite
+    mask = np.zeros((B, n_max))
+    lo = np.empty((B, ndim))
+    hi = np.empty((B, ndim))
+    p0 = np.empty((B, walkers, ndim))
+    for i, p in enumerate(problems):
+        nb = np.asarray(p["basis"], dtype=np.float64)
+        n = nb.shape[0]
+        basis[i, :n] = nb
+        y[i, :n] = np.asarray(p["y"], dtype=np.float64)
+        err[i, :n] = np.asarray(p["err"], dtype=np.float64)
+        mask[i, :n] = 1.0
+        lo[i] = np.asarray(p["lo"], dtype=np.float64)
+        hi[i] = np.asarray(p["hi"], dtype=np.float64)
+        rng = np.random.default_rng([seed, i])
+        for d in range(ndim):
+            p0[i, :, d] = rng.uniform(lo[i, d], hi[i, d], size=walkers)
+
+    keys_all = jax.random.split(jax.random.PRNGKey(seed), B)
+    chunk = _resolve_chunk(B, n_max * max(walkers, 1))
+    obs.counter_add("mcmc_sources_batched", B)
+    chains = np.empty((B, steps, walkers, ndim))
+    lps = np.empty((B, steps, walkers))
+    with obs.span("mcmc_sources", sources=B, steps=steps, walkers=walkers,
+                  chunk=chunk, n_toas_padded=n_max):
+        for start in range(0, B, chunk):
+            sl = slice(start, min(start + chunk, B))
+            data = {
+                "basis": jnp.asarray(basis[sl]), "y": jnp.asarray(y[sl]),
+                "err": jnp.asarray(err[sl]), "mask": jnp.asarray(mask[sl]),
+                "lo": jnp.asarray(lo[sl]), "hi": jnp.asarray(hi[sl]),
+            }
+            c_j, l_j = mcmc_ops.ensemble_sample_batch(
+                mcmc_ops.delta_logprob, jnp.asarray(p0[sl]), data, steps,
+                stretch_a=stretch_a, keys=keys_all[sl],
+            )
+            costmodel.capture(
+                "mcmc_ensemble_sources", mcmc_ops._ensemble_batch_core,
+                mcmc_ops.delta_logprob, jnp.asarray(p0[sl]), data, steps,
+                keys_all[sl], stretch_a,
+            )
+            chains[sl] = np.asarray(c_j)
+            lps[sl] = np.asarray(l_j)
+    return chains, lps

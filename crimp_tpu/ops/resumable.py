@@ -156,6 +156,13 @@ class ResumableScan:
             "grid_mxu": [int(self._mxu), self._mxu_reseed,
                          int(self._mxu_bf16)],
             "delta_fold": [int(self._delta_fold), self._delta_fold_budget],
+            # the delta-basis MCMC likelihood never runs inside a grid
+            # scan, but it shares the session's numeric-mode fingerprint
+            # (GL003): a store resumed under a different sampler mode must
+            # be visibly incompatible rather than silently mixed
+            "mcmc_delta": [
+                int(autotune.resolve_mcmc_delta(len(self.times))["mcmc_delta"])
+            ],
         }
         self._times_dev = None  # lazy device-resident copy of the events
         self.store = pathlib.Path(store) if store is not None else None

@@ -129,18 +129,73 @@ def _delta_model_updates(parfile: dict, keys: list[str]):
     return updates
 
 
-def make_logprob(parfile: dict, keys: list[str], prior: Prior, x, y, yerr):
-    """Jittable log-probability over the free-parameter delta vector."""
-    import jax
+# The exact likelihood is built in two parts so the jitted ensemble cores
+# (ops/mcmc.py) never retrace across run_mcmc calls: the FUNCTION depends
+# only on the free-parameter structure (which TimingParams fields update,
+# the wave branches) and is cached per structure, while every array — the
+# ToAs, the centered data, the base model pytree — travels as a traced
+# ``data`` argument. A fresh closure per run was a fresh jit cache key per
+# run; a cached (theta, data) function is one compile per (structure,
+# shape) family for the life of the process.
+_EXACT_LP_CACHE: dict = {}
+
+
+def _exact_logprob_fn(updates: tuple, f0_key_idx: int | None,
+                      any_wave: bool, all_wave: bool):
+    """The (theta, data) exact log-probability for one free-set structure."""
+    cache_key = (updates, f0_key_idx, any_wave, all_wave)
+    cached = _EXACT_LP_CACHE.get(cache_key)
+    if cached is not None:
+        return cached
     import jax.numpy as jnp
     from dataclasses import replace
+
+    from crimp_tpu.ops import fold as fold_ops
+
+    def log_prob(theta, data):
+        in_box = jnp.all((theta > data["lo"]) & (theta < data["hi"]))
+        tm = data["base_tm"]
+        for (field, idx), value in zip(updates, theta):
+            arr = jnp.asarray(getattr(tm, field)).at[idx].set(value)
+            tm = replace(tm, **{field: arr})
+        # Waves are seconds-residuals scaled by the FULL F0
+        # (utilities_fittoas.py:269-293).
+        full_f0 = (data["full_f0"] - theta[f0_key_idx]
+                   if f0_key_idx is not None else data["full_f0"])
+        wave_tm = replace(tm, f=jnp.asarray(tm.f).at[0].set(full_f0))
+        x_j = data["x"]
+        if all_wave:
+            mu = fold_ops.wave_phase(wave_tm, x_j)
+        elif any_wave:
+            mu = (
+                fold_ops.taylor_phase(tm, x_j)
+                + fold_ops.glitch_phase(tm, x_j)
+                + fold_ops.wave_phase(wave_tm, x_j)
+            )
+        else:
+            mu = (fold_ops.taylor_phase(tm, x_j) + fold_ops.glitch_phase(tm, x_j)
+                  + data["frozen_waves"])
+        mu = mu - jnp.mean(mu)
+        resid = (data["y"] - mu) / data["yerr"]
+        nll = 0.5 * jnp.sum(resid**2 + jnp.log(2 * jnp.pi * data["yerr"]**2))
+        return jnp.where(in_box, -nll, -jnp.inf)
+
+    _EXACT_LP_CACHE[cache_key] = log_prob
+    return log_prob
+
+
+def make_logprob_parts(parfile: dict, keys: list[str], prior: Prior, x, y, yerr):
+    """(log_prob_fn, data): the exact likelihood as a stable function plus
+    a traced observation pytree — pass both to ops/mcmc.py so repeated
+    runs at the same shapes reuse one compiled ensemble core."""
+    import jax.numpy as jnp
 
     from crimp_tpu.ops import fold as fold_ops
 
     fit_dict, full_dict = fit_utils.inject_free_params(parfile, np.zeros(len(keys)), keys)
     base_tm = timing.from_dict(fit_dict)
     full_f0_base = float(get_parameter_value(parfile["F0"]))
-    updates = _delta_model_updates(parfile, keys)
+    updates = tuple(_delta_model_updates(parfile, keys))
     f0_key_idx = keys.index("F0") if "F0" in keys else None
 
     lo = jnp.asarray([prior.bounds.get(k, (-np.inf, np.inf))[0] for k in keys])
@@ -153,38 +208,111 @@ def make_logprob(parfile: dict, keys: list[str], prior: Prior, x, y, yerr):
     any_wave = any("wave" in k.lower() for k in keys)
     all_wave = all("wave" in k.lower() for k in keys) and len(keys) > 0
 
-    def apply_updates(theta):
-        tm = base_tm
-        for (field, idx), value in zip(updates, theta):
-            arr = jnp.asarray(getattr(tm, field)).at[idx].set(value)
-            tm = replace(tm, **{field: arr})
-        return tm
+    # theta-independent whitening-wave phases (the non-wave-fit branch):
+    # computed once here instead of once per proposal inside the scan
+    frozen_waves = fold_ops.wave_phase(timing.from_dict(full_dict), x_j)
+    data = {
+        "lo": lo, "hi": hi, "x": x_j, "y": y_centered, "yerr": yerr_j,
+        "base_tm": base_tm, "full_f0": jnp.asarray(full_f0_base),
+        "frozen_waves": frozen_waves,
+    }
+    return _exact_logprob_fn(updates, f0_key_idx, any_wave, all_wave), data
+
+
+def make_logprob(parfile: dict, keys: list[str], prior: Prior, x, y, yerr):
+    """Jittable log-probability over the free-parameter delta vector."""
+    log_prob_fn, data = make_logprob_parts(parfile, keys, prior, x, y, yerr)
 
     def log_prob(theta):
-        in_box = jnp.all((theta > lo) & (theta < hi))
-        tm = apply_updates(theta)
-        # Waves are seconds-residuals scaled by the FULL F0
-        # (utilities_fittoas.py:269-293).
-        full_f0 = full_f0_base - theta[f0_key_idx] if f0_key_idx is not None else full_f0_base
-        wave_tm = replace(tm, f=jnp.asarray(tm.f).at[0].set(full_f0))
-        if all_wave:
-            mu = fold_ops.wave_phase(wave_tm, x_j)
-        elif any_wave:
-            mu = (
-                fold_ops.taylor_phase(tm, x_j)
-                + fold_ops.glitch_phase(tm, x_j)
-                + fold_ops.wave_phase(wave_tm, x_j)
-            )
-        else:
-            full_tm = timing.from_dict(full_dict)
-            frozen_waves = fold_ops.wave_phase(full_tm, x_j)
-            mu = fold_ops.taylor_phase(tm, x_j) + fold_ops.glitch_phase(tm, x_j) + frozen_waves
-        mu = mu - jnp.mean(mu)
-        resid = (y_centered - mu) / yerr_j
-        nll = 0.5 * jnp.sum(resid**2 + jnp.log(2 * jnp.pi * yerr_j**2))
-        return jnp.where(in_box, -nll, -jnp.inf)
+        return log_prob_fn(theta, data)
 
     return log_prob
+
+
+def make_logprob_delta(parfile: dict, keys: list[str], prior: Prior, x, y, yerr,
+                       budget: float):
+    """(data, info) for the delta-basis MCMC likelihood, or (None, info).
+
+    Within the linear regime the delta-parameterized model is exactly
+    ``mu = B_free @ theta`` against the per-run precomputed delta-fold
+    basis (fit_utils.delta_basis, the model_phase_residuals_delta column
+    conventions), so every proposal scores as one ndim-long matvec —
+    vmapped over walkers, one ``(walkers x ndim) @ (ndim x nToA)`` matmul
+    per half-ensemble update (ops/mcmc.py delta_logprob).
+
+    The host-side precision guard refuses the linear path — (None, info)
+    with the reason — whenever:
+
+    - any free key is outside the linear family (epochs, GLTD, waves:
+      ``linear_key_columns`` returns None; the nonlinear parameters are
+      instead frozen into the basis, fingerprinted by ``nonlinear_sha``);
+    - a free key has no finite prior box (the box extent is the guard's
+      domain);
+    - ``error_bound_cycles`` over the WALKER BOX EXTENT (the worst-case
+      |theta| inside [lo, hi] — every finite-probability walker lives
+      there) exceeds ``budget``.
+
+    Callers fall back to the exact likelihood, which is bit-identical to
+    the knob-off path by construction.
+    """
+    import jax.numpy as jnp
+
+    from crimp_tpu.ops import deltafold
+    from crimp_tpu.ops import fold as fold_ops
+
+    info: dict = {"eligible": False, "reason": None}
+    cols = fit_utils.linear_key_columns(parfile, keys)
+    if not keys or cols is None:
+        info["reason"] = "nonlinear_free_param"
+        return None, info
+
+    lo = np.asarray([prior.bounds.get(k, (-np.inf, np.inf))[0] for k in keys])
+    hi = np.asarray([prior.bounds.get(k, (-np.inf, np.inf))[1] for k in keys])
+    if not (np.all(np.isfinite(lo)) and np.all(np.isfinite(hi))):
+        info["reason"] = "unbounded_prior"
+        return None, info
+
+    fit_dict, full_dict = fit_utils.inject_free_params(parfile, np.zeros(len(keys)), keys)
+    fit_tm = timing.from_dict(fit_dict)
+    full_tm = timing.from_dict(full_dict)
+    t = np.atleast_1d(np.asarray(x, dtype=np.float64))
+    b, colmax = fit_utils.delta_basis(fit_tm, t)
+
+    # worst-case |theta| over the prior box: outside it the log-prob is
+    # -inf regardless of the model, so the box extent bounds every matmul
+    # the sampler will ever trust
+    dp_box = np.zeros(deltafold.n_params(fit_tm.n_glitch))
+    dp_box[cols] = np.maximum(np.abs(lo), np.abs(hi))
+    bound = deltafold.error_bound_cycles(colmax, dp_box)
+    info.update(
+        bound_cycles=bound,
+        budget_cycles=float(budget),
+        nonlinear_sha=deltafold.nonlinear_sha(fit_tm),
+        n_toas=int(t.size),
+        ndim=len(keys),
+    )
+    if bound > budget:
+        info["reason"] = "error_bound_exceeds_budget"
+        return None, info
+
+    # center the data against the frozen whitening waves so the device
+    # likelihood matches the exact path's center(B@theta + waves) exactly
+    y_c = np.asarray(y, dtype=float)
+    y_c = y_c - y_c.mean()
+    if full_tm.n_wave:
+        w = np.asarray(fold_ops.wave_phase(full_tm, jnp.asarray(t)), dtype=np.float64)
+        y_c = y_c - (w - w.mean())
+
+    info["eligible"] = True
+    data = {
+        "basis": jnp.asarray(np.asarray(b)[:, cols]),
+        "y": jnp.asarray(y_c),
+        "err": jnp.asarray(np.asarray(yerr, dtype=float)),
+        "mask": jnp.ones(t.size),
+        "lo": jnp.asarray(lo),
+        "hi": jnp.asarray(hi),
+    }
+    return data, info
 
 
 def run_mcmc(
@@ -202,11 +330,24 @@ def run_mcmc(
     flat_npy: str | None = None,
     progress: bool = True,
     seed: int = 0,
+    mcmc_delta: int | None = None,
 ):
     """Ensemble-MCMC posterior sampling (replaces emcee; fit_toas.py:140-202).
 
+    ``mcmc_delta`` overrides the CRIMP_TPU_MCMC_DELTA resolution (env >
+    cached bench A/B winner > off). When the delta path is on AND the
+    precision guard admits the free set (make_logprob_delta), proposals
+    score as basis matmuls; any guard trip or runtime failure falls back
+    to the exact likelihood — bit-identical to the knob-off run, counted
+    in the obs manifest (mcmc_guard_fallbacks / degraded_mcmc_*).
+
     Returns (chain, flat, summaries)."""
     import jax
+
+    from crimp_tpu import resilience
+    from crimp_tpu.obs import costmodel
+    from crimp_tpu.ops import autotune
+    from crimp_tpu.resilience import faultinject
 
     rng = np.random.default_rng(seed)
     ndim = len(keys)
@@ -215,12 +356,54 @@ def run_mcmc(
         lo, hi = prior.bounds[name]
         p0[:, i] = rng.uniform(lo, hi, size=walkers)
 
-    log_prob = make_logprob(init_parfile, keys, prior, x, y, yerr)
-    chain, lps = mcmc_ops.ensemble_sample(
-        log_prob, np.asarray(p0), steps, jax.random.PRNGKey(seed)
-    )
-    chain = np.asarray(chain)
-    lps = np.asarray(lps)
+    cfg = autotune.resolve_mcmc_delta(np.size(np.asarray(x)))
+    if mcmc_delta is not None:
+        cfg["mcmc_delta"] = int(bool(mcmc_delta))
+
+    key = jax.random.PRNGKey(seed)
+    obs.counter_add("mcmc_proposals_evaluated", steps * walkers)
+    chain = None
+    if cfg["mcmc_delta"]:
+        data, delta_info = make_logprob_delta(
+            init_parfile, keys, prior, x, y, yerr, budget=cfg["budget"]
+        )
+        if data is None:
+            obs.counter_add("mcmc_guard_fallbacks", 1)
+            logger.info("delta-basis MCMC guard fallback (%s); using the "
+                        "exact likelihood", delta_info.get("reason"))
+        else:
+            try:
+                faultinject.fire("mcmc_step")
+                chain_j, lps_j = mcmc_ops.ensemble_sample(
+                    mcmc_ops.delta_logprob, np.asarray(p0), steps, key, data=data
+                )
+                chain = np.asarray(chain_j)
+                lps = np.asarray(lps_j)
+                if np.isnan(lps).any():
+                    raise resilience.NonfiniteResultError(
+                        "delta-basis MCMC produced NaN log-probabilities"
+                    )
+                costmodel.capture(
+                    "mcmc_ensemble_delta", mcmc_ops._ensemble_core,
+                    mcmc_ops.delta_logprob, np.asarray(p0), data, steps, key, 2.0,
+                )
+                obs.counter_add("mcmc_delta_path_steps", steps)
+            except Exception as exc:  # noqa: BLE001 — any delta-path failure steps the ladder to the exact-likelihood rung
+                kind = resilience.classify(exc)
+                resilience.record_degradation("mcmc", "exact_likelihood", kind)
+                logger.warning(
+                    "delta-basis MCMC failed (%s); falling back to the exact "
+                    "likelihood", kind.value, exc_info=True,
+                )
+                chain = None
+
+    if chain is None:
+        log_prob_fn, lp_data = make_logprob_parts(init_parfile, keys, prior, x, y, yerr)
+        chain_j, lps_j = mcmc_ops.ensemble_sample(
+            log_prob_fn, np.asarray(p0), steps, key, data=lp_data
+        )
+        chain = np.asarray(chain_j)
+        lps = np.asarray(lps_j)
     if chain_npy:
         np.save(chain_npy, chain)
     flat, flat_lp, summaries = mcmc_ops.summarize_chain(chain, lps, keys, burn=max(0, burn))

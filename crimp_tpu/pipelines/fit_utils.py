@@ -182,6 +182,72 @@ _LINEAR_GL_RE = re.compile(r"^(GLPH|GLF0D|GLF0|GLF1|GLF2)_(\S+)$")
 _GL_COL = {"GLPH": 0, "GLF0": 1, "GLF1": 2, "GLF2": 3, "GLF0D": 4}
 
 
+def linear_key_columns(timmodel: dict, keys: list[str]) -> list[int] | None:
+    """Delta-fold basis column index per free key, or None if ineligible.
+
+    The phase model is exactly linear in the F0..F12 spin deltas and the
+    per-glitch [GLPH, GLF0, GLF1, GLF2, GLF0D] amplitude deltas once the
+    epochs are fixed; those keys map onto the ops/deltafold.py basis
+    layout (column m < N_FREQ_TERMS is dt^(m+1)/(m+1)!; glitch blocks of
+    N_GLITCH_AMP follow in GLEP order). Any other key — epochs, GLTD,
+    waves, or a glitch suffix with no matching GLEP — makes the free set
+    non-linear and returns None, so callers fall back to the exact path.
+    Shared by the post-fit refold fast path below and the delta-basis MCMC
+    likelihood (pipelines/fit_toas.py).
+    """
+    from crimp_tpu.ops import deltafold
+
+    gids = [mm.group(1) for k in timmodel
+            if (mm := re.match(r"GLEP_(\S+)$", k))]
+    cols: list[int] = []
+    for key in keys:
+        m = _LINEAR_F_RE.match(key)
+        if m:
+            idx = int(m.group(1))
+            if idx >= timing.N_FREQ_TERMS:
+                return None
+            cols.append(idx)
+            continue
+        m = _LINEAR_GL_RE.match(key)
+        if m:
+            if m.group(2) not in gids:
+                return None
+            cols.append(timing.N_FREQ_TERMS
+                        + deltafold.N_GLITCH_AMP * gids.index(m.group(2))
+                        + _GL_COL[m.group(1)])
+            continue
+        return None
+    return cols
+
+
+def delta_basis(fit_tm, x_mjd):
+    """(N, n_params) delta-fold basis anchored at PEPOCH (fit-path
+    conventions: single anchor, ``wave_in_f0=False`` — whitening waves are
+    frozen at their full values and never enter the free columns).
+
+    Returns (basis (jax array), colmax (np array of per-column max |B|))
+    — colmax feeds ``deltafold.error_bound_cycles`` so callers can bound
+    the f64 matmul error before trusting the linear path.
+    """
+    import jax.numpy as jnp
+
+    from crimp_tpu.ops import deltafold
+
+    t = np.atleast_1d(np.asarray(x_mjd, dtype=np.float64))
+    pepoch = float(np.asarray(fit_tm.pepoch))
+    delta_sec = np.asarray(
+        (np.asarray(t, dtype=np.longdouble) - np.longdouble(pepoch))  # graftlint: disable=GL004 (host-side epoch-delta in anchored.py's longdouble convention; only the rounded f64 result reaches the device basis)
+        * np.longdouble(anchored.SECONDS_PER_DAY),  # graftlint: disable=GL004 (same host-side epoch-delta; f64 is taken after the exact subtraction)
+        dtype=np.float64,
+    )
+    spec = deltafold.basis_spec(fit_tm, np.asarray([pepoch]))
+    anchor_idx = np.zeros(t.size, dtype=np.int64)
+    b = deltafold.basis_rows(spec, jnp.asarray(delta_sec),
+                             jnp.asarray(anchor_idx), wave_in_f0=False)
+    colmax = np.asarray(jnp.max(jnp.abs(b), axis=0))
+    return b, colmax
+
+
 def model_phase_residuals_delta(x_mjd, timmodel: dict, pvec, keys: list[str],
                                 cfg: dict | None = None) -> np.ndarray | None:
     """Delta-fold fast path for model_phase_residuals: B @ dp as one f64
@@ -203,19 +269,8 @@ def model_phase_residuals_delta(x_mjd, timmodel: dict, pvec, keys: list[str],
         cfg = deltafold.resolve(t.size)
     if not cfg["delta_fold"] or not keys:
         return None
-    parsed = []
-    for key in keys:
-        m = _LINEAR_F_RE.match(key)
-        if m:
-            idx = int(m.group(1))
-            if idx >= timing.N_FREQ_TERMS:
-                return None
-            parsed.append(("f", idx))
-            continue
-        m = _LINEAR_GL_RE.match(key)
-        if m:
-            parsed.append((m.group(1), m.group(2)))
-            continue
+    cols = linear_key_columns(timmodel, keys)
+    if cols is None:
         return None
 
     fit_dict, full_dict = inject_free_params(timmodel, pvec, keys)
@@ -223,32 +278,12 @@ def model_phase_residuals_delta(x_mjd, timmodel: dict, pvec, keys: list[str],
     # GLTD zeroed in delta space — recovery columns inert, matching
     # _host_glitch_phase on fit_tm), waves frozen at their FULL values
     fit_tm = timing.from_dict(fit_dict)
-    gids = [mm.group(1) for k in fit_dict
-            if (mm := re.match(r"GLEP_(\S+)$", k))]
     dp = np.zeros(deltafold.n_params(fit_tm.n_glitch))
-    for (kind, which), val in zip(parsed, np.asarray(pvec, dtype=np.float64)):
-        if kind == "f":
-            dp[which] = val
-        else:
-            if which not in gids:
-                return None
-            dp[timing.N_FREQ_TERMS
-               + deltafold.N_GLITCH_AMP * gids.index(which)
-               + _GL_COL[kind]] = val
+    dp[cols] = np.asarray(pvec, dtype=np.float64)
 
     import jax.numpy as jnp
 
-    pepoch = float(np.asarray(fit_tm.pepoch))
-    delta_sec = np.asarray(
-        (np.asarray(t, dtype=np.longdouble) - np.longdouble(pepoch))  # graftlint: disable=GL004 (host-side epoch-delta in anchored.py's longdouble convention; only the rounded f64 result reaches the device basis)
-        * np.longdouble(anchored.SECONDS_PER_DAY),  # graftlint: disable=GL004 (same host-side epoch-delta; f64 is taken after the exact subtraction)
-        dtype=np.float64,
-    )
-    spec = deltafold.basis_spec(fit_tm, np.asarray([pepoch]))
-    anchor_idx = np.zeros(t.size, dtype=np.int64)
-    b = deltafold.basis_rows(spec, jnp.asarray(delta_sec),
-                             jnp.asarray(anchor_idx), wave_in_f0=False)
-    colmax = np.asarray(jnp.max(jnp.abs(b), axis=0))
+    b, colmax = delta_basis(fit_tm, t)
     if deltafold.error_bound_cycles(colmax, dp) > cfg["budget"]:
         return None
     phases = np.asarray(b @ jnp.asarray(dp), dtype=np.float64)

@@ -37,24 +37,15 @@ logger = get_logger(__name__)
 FIT_KEYS = ["F0", "F1"]
 
 
-def _window_log_prob(theta, data):
-    """Delta-parameterized local model: mu = basis @ theta with the rank-2
-    Taylor basis [dt, dt^2/2] (seconds from the window anchor) — a window's
-    [dF0, dF1] trial is exactly a rank-2 delta-fold (ops/deltafold.py
-    taylor_basis_seconds), so the per-trial model is one small matmul —
-    mean-subtracted over valid ToAs, masked for padding."""
-    import jax.numpy as jnp
-
-    basis, y, err, mask, lo, hi = (
-        data["basis"], data["y"], data["err"], data["mask"], data["lo"],
-        data["hi"],
-    )
-    in_box = jnp.all((theta > lo) & (theta < hi))
-    mu = basis @ theta
-    mu = mu - jnp.sum(mu * mask) / jnp.sum(mask)
-    resid = (y - mu) / err
-    nll = 0.5 * jnp.sum(mask * (resid**2 + jnp.log(2 * jnp.pi * err**2)))
-    return jnp.where(in_box, -nll, -jnp.inf)
+# Delta-parameterized local model: mu = basis @ theta with the rank-2
+# Taylor basis [dt, dt^2/2] (seconds from the window anchor) — a window's
+# [dF0, dF1] trial is exactly a rank-2 delta-fold (ops/deltafold.py
+# taylor_basis_seconds), so the per-trial model is one small matmul,
+# mean-subtracted over valid ToAs, masked for padding. This is the SAME
+# masked basis-matmul likelihood the delta-basis MCMC engine uses
+# everywhere (ops/mcmc.py), so the windowed batch shares its compiled
+# ensemble core with the other pipelines.
+_window_log_prob = mcmc_ops.delta_logprob
 
 
 def _fit_windows_batched(windows: list[dict], steps: int, burn: int, walkers: int,
@@ -90,7 +81,7 @@ def _fit_windows_batched(windows: list[dict], steps: int, burn: int, walkers: in
         "mask": jnp.asarray(mask), "lo": jnp.asarray(lo), "hi": jnp.asarray(hi),
     }
     chains, lps = mcmc_ops.ensemble_sample_batch(
-        _window_log_prob, jnp.asarray(p0), data, steps, jax.random.PRNGKey(0)
+        mcmc_ops.delta_logprob, jnp.asarray(p0), data, steps, jax.random.PRNGKey(0)
     )
     chains = np.asarray(chains)
     lps = np.asarray(lps)

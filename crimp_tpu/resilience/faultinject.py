@@ -32,6 +32,7 @@ FAULT_POINTS = frozenset({
     "survey_bucket",   # pipelines/survey.py: batched bucket processing
     "tuner_cache",     # ops/autotune.py: tuner cache JSON load
     "scan_chunk",      # ops/resumable.py: chunk compute + chunk resume load
+    "mcmc_step",       # pipelines/fit_toas.py: delta-basis MCMC dispatch
     "serve_admission",  # serve/admission.py: request admission
     "serve_dispatch",  # serve/engine.py: batched/warm request dispatch
     "serve_deadline",  # serve/scheduler.py: deadline-budget evaluation

@@ -60,6 +60,7 @@ LADDERS = {
     "multisource": ("batched", "split_bucket", "per_source"),
     "grid": ("grid_mxu", "streamed", "exact"),
     "fold": ("delta_fold", "exact_refold"),
+    "mcmc": ("delta_basis", "exact_likelihood"),
     "device": ("accelerator", "cpu_pinned"),
 }
 

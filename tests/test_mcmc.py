@@ -112,3 +112,83 @@ class TestEnsembleSampleBatch:
             flat = chains[b, 500:].reshape(-1, 2)
             np.testing.assert_allclose(flat.mean(axis=0), mus[b], atol=0.25 * sigmas[b] + 0.1)
             np.testing.assert_allclose(flat.std(axis=0), sigmas[b], rtol=0.25)
+
+    def test_presplit_keys_match_single_key(self):
+        """ensemble_sample_batch(keys=split(key, B)) must be bitwise the
+        classic key form — the contract that lets multisource chunk a big
+        batch without changing any source's random stream."""
+        import jax
+        import jax.numpy as jnp
+
+        from crimp_tpu.ops import mcmc as mcmc_ops
+
+        def log_prob(theta, data):
+            return -0.5 * jnp.sum((theta - data["mu"]) ** 2)
+
+        p0 = jnp.asarray(np.random.RandomState(2).uniform(-1, 1, (4, 8, 2)))
+        data = {"mu": jnp.asarray(np.linspace(-1, 1, 4))[:, None]}
+        key = jax.random.PRNGKey(11)
+        c1, l1 = mcmc_ops.ensemble_sample_batch(log_prob, p0, data, 40, key)
+        c2, l2 = mcmc_ops.ensemble_sample_batch(
+            log_prob, p0, data, 40, keys=jax.random.split(key, 4)
+        )
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+
+
+class TestSummarizeChainBurnGuard:
+    def test_burn_equal_to_steps_raises(self):
+        chain = np.zeros((50, 8, 2))
+        lps = np.zeros((50, 8))
+        with pytest.raises(ValueError, match=r"burn \(50\) must be smaller"):
+            mcmc.summarize_chain(chain, lps, ["a", "b"], burn=50)
+
+    def test_burn_beyond_steps_raises(self):
+        chain = np.zeros((10, 4, 1))
+        lps = np.zeros((10, 4))
+        with pytest.raises(ValueError, match="nothing would be left"):
+            mcmc.summarize_chain(chain, lps, ["a"], burn=500)
+
+    def test_burn_just_under_steps_ok(self):
+        rng = np.random.RandomState(0)
+        chain = rng.normal(size=(10, 4, 1))
+        lps = rng.normal(size=(10, 4))
+        flat, _, _ = mcmc.summarize_chain(chain, lps, ["a"], burn=9)
+        assert flat.shape == (4, 1)
+
+
+class TestEffectiveSampleSize:
+    def _ar1(self, rho, steps, walkers, seed=0):
+        rng = np.random.RandomState(seed)
+        x = np.zeros((steps, walkers))
+        x[0] = rng.normal(size=walkers)
+        innov = rng.normal(size=(steps, walkers)) * np.sqrt(1 - rho**2)
+        for tstep in range(1, steps):
+            x[tstep] = rho * x[tstep - 1] + innov[tstep]
+        return x
+
+    def test_ar1_matches_theory(self):
+        """AR(1) with coefficient rho has exactly tau = (1+rho)/(1-rho)."""
+        for rho in (0.5, 0.9):
+            x = self._ar1(rho, 20000, 8)
+            tau_true = (1 + rho) / (1 - rho)
+            ess = mcmc.effective_sample_size(x)
+            np.testing.assert_allclose(ess, x.size / tau_true, rtol=0.2)
+
+    def test_white_noise_is_full_size(self):
+        x = np.random.RandomState(1).normal(size=(5000, 4))
+        ess = mcmc.effective_sample_size(x)
+        np.testing.assert_allclose(ess, x.size, rtol=0.15)
+
+    def test_constant_chain(self):
+        x = np.ones((100, 4))
+        assert mcmc.effective_sample_size(x) == 400.0
+
+    def test_shapes(self):
+        x3 = np.random.RandomState(2).normal(size=(500, 4, 3))
+        out = mcmc.effective_sample_size(x3)
+        assert out.shape == (3,)
+        x1 = np.random.RandomState(3).normal(size=800)
+        assert np.isscalar(mcmc.effective_sample_size(x1))
+        with pytest.raises(ValueError, match="1-D, 2-D or 3-D"):
+            mcmc.effective_sample_size(np.zeros((2, 2, 2, 2)))

@@ -1,0 +1,284 @@
+"""Delta-basis MCMC engine: guard, fallback, parity, chaos, compile stability.
+
+The contract under test (docs/performance.md "Delta-basis MCMC"):
+
+* knob off -> the exact likelihood samples bit-identically run to run;
+* knob on + guard-admitted -> posteriors statistically equivalent to the
+  exact chain (here the quantiles agree tightly at a fixed seed);
+* knob on + guard-tripped (nonlinear free key, unbounded prior, error
+  bound over budget) -> the run falls back to the exact likelihood and
+  the chain is BITWISE the knob-off chain;
+* an injected fault at the mcmc_step point degrades to the
+  exact-likelihood rung, stamps the obs manifest, and still returns the
+  knob-off bits;
+* repeated runs never retrace the jitted ensemble cores.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from crimp_tpu import obs  # noqa: E402
+from crimp_tpu.io.yamlcfg import Prior  # noqa: E402
+from crimp_tpu.obs.manifest import load_manifest  # noqa: E402
+from crimp_tpu.ops import mcmc as mcmc_ops  # noqa: E402
+from crimp_tpu.pipelines import fit_toas, fit_utils  # noqa: E402
+from crimp_tpu.resilience import faultinject  # noqa: E402
+
+PEPOCH = 58000.0
+KEYS = ["F0", "F1", "GLF0_1"]
+WIDTHS = {"F0": 1e-8, "F1": 1e-16, "GLF0_1": 2e-9}
+
+
+@pytest.fixture(autouse=True)
+def _quiet_knobs(monkeypatch):
+    # pin the resolution rungs: no tuner cache, no env override, no faults
+    monkeypatch.setenv("CRIMP_TPU_AUTOTUNE", "0")
+    monkeypatch.delenv("CRIMP_TPU_MCMC_DELTA", raising=False)
+    monkeypatch.delenv("CRIMP_TPU_FAULTS", raising=False)
+    faultinject.reset()
+
+
+@pytest.fixture()
+def obs_on(monkeypatch, tmp_path):
+    out = tmp_path / "obs"
+    monkeypatch.setenv("CRIMP_TPU_OBS", "1")
+    monkeypatch.setenv("CRIMP_TPU_OBS_DIR", str(out))
+    return out
+
+
+def _problem(n_toas: int = 150, widths: dict | None = None):
+    """Glitch-bearing synthetic fit: parfile, keys, prior, t, y, yerr."""
+    widths = dict(widths or WIDTHS)
+    base = {"PEPOCH": PEPOCH, "F0": 6.45, "F1": -1e-13, "F2": 0.0,
+            "GLEP_1": PEPOCH + 60.0, "GLPH_1": 1e-3, "GLF0_1": 1e-7,
+            "GLF1_1": -1e-15, "GLF0D_1": 5e-8, "GLTD_1": 40.0}
+    parfile = {k: {"value": np.float64(v), "flag": int(k in KEYS)}
+               for k, v in base.items()}
+    prior = Prior(bounds={k: (-w, w) for k, w in widths.items()},
+                  initial_guess={})
+    rng = np.random.default_rng(7)
+    t = np.sort(rng.uniform(PEPOCH, PEPOCH + 180.0, n_toas))
+    truth = np.array([0.3 * widths[k] for k in KEYS])
+    sigma = 0.01
+    y = fit_utils.model_phase_residuals(t, parfile, truth, KEYS) \
+        + rng.normal(0.0, sigma, n_toas)
+    yerr = np.full(n_toas, sigma)
+    return parfile, prior, t, y, yerr
+
+
+def _run(parfile, prior, t, y, yerr, mcmc_delta, steps=120, seed=0):
+    chain, flat, summaries = fit_toas.run_mcmc(
+        t, y, yerr, parfile, KEYS, prior, steps=steps, burn=20, walkers=16,
+        seed=seed, mcmc_delta=mcmc_delta,
+    )
+    return np.asarray(chain), summaries
+
+
+class TestGuard:
+    def test_eligible_linear_problem(self):
+        parfile, prior, t, y, yerr = _problem()
+        data, info = fit_toas.make_logprob_delta(
+            parfile, KEYS, prior, t, y, yerr, budget=1e-9)
+        assert data is not None
+        assert info["eligible"] is True
+        assert info["bound_cycles"] < info["budget_cycles"]
+        assert data["basis"].shape == (len(t), len(KEYS))
+        assert isinstance(info["nonlinear_sha"], str)
+
+    def test_nonlinear_free_key_refused(self):
+        parfile, prior, t, y, yerr = _problem()
+        prior.bounds["GLTD_1"] = (1.0, 100.0)
+        data, info = fit_toas.make_logprob_delta(
+            parfile, KEYS + ["GLTD_1"], prior, t, y, yerr, budget=1e-9)
+        assert data is None
+        assert info["reason"] == "nonlinear_free_param"
+
+    def test_unbounded_prior_refused(self):
+        parfile, prior, t, y, yerr = _problem()
+        prior.bounds["F0"] = (-np.inf, np.inf)
+        data, info = fit_toas.make_logprob_delta(
+            parfile, KEYS, prior, t, y, yerr, budget=1e-9)
+        assert data is None
+        assert info["reason"] == "unbounded_prior"
+
+    def test_wide_box_exceeds_budget(self):
+        parfile, prior, t, y, yerr = _problem(
+            widths={"F0": 1e3, "F1": 1.0, "GLF0_1": 1e3})
+        data, info = fit_toas.make_logprob_delta(
+            parfile, KEYS, prior, t, y, yerr, budget=1e-9)
+        assert data is None
+        assert info["reason"] == "error_bound_exceeds_budget"
+        assert info["bound_cycles"] > info["budget_cycles"]
+
+
+class TestDeltaLogprob:
+    def test_masked_rows_are_inert_bitwise(self):
+        """At a FIXED padded width, the values in mask==0 rows must not
+        change the log-probability by a single bit."""
+        rng = np.random.default_rng(1)
+        n, pad, ndim = 24, 8, 2
+        basis = rng.normal(size=(n + pad, ndim))
+        y = rng.normal(size=n + pad)
+        err = np.abs(rng.normal(1.0, 0.1, n + pad))
+        mask = np.concatenate([np.ones(n), np.zeros(pad)])
+
+        def lp(b, yy, ee):
+            import jax.numpy as jnp
+            data = {"basis": jnp.asarray(b), "y": jnp.asarray(yy),
+                    "err": jnp.asarray(ee), "mask": jnp.asarray(mask),
+                    "lo": jnp.asarray([-10.0, -10.0]),
+                    "hi": jnp.asarray([10.0, 10.0])}
+            return np.asarray(mcmc_ops.delta_logprob(
+                jnp.asarray([0.3, -0.2]), data))
+
+        clean = lp(basis, y, err)
+        b2, y2, e2 = basis.copy(), y.copy(), err.copy()
+        b2[n:] = 1e6
+        y2[n:] = -1e6
+        e2[n:] = 3.0
+        np.testing.assert_array_equal(clean, lp(b2, y2, e2))
+
+    def test_box_gate_is_minus_inf(self):
+        import jax.numpy as jnp
+        data = {"basis": jnp.ones((4, 1)), "y": jnp.zeros(4),
+                "err": jnp.ones(4), "mask": jnp.ones(4),
+                "lo": jnp.asarray([-1.0]), "hi": jnp.asarray([1.0])}
+        assert np.isneginf(
+            np.asarray(mcmc_ops.delta_logprob(jnp.asarray([2.0]), data)))
+        assert np.isfinite(
+            np.asarray(mcmc_ops.delta_logprob(jnp.asarray([0.5]), data)))
+
+
+class TestRunMcmcDelta:
+    def test_knob_off_bit_stable(self):
+        parfile, prior, t, y, yerr = _problem()
+        c1, _ = _run(parfile, prior, t, y, yerr, mcmc_delta=0)
+        c2, _ = _run(parfile, prior, t, y, yerr, mcmc_delta=0)
+        np.testing.assert_array_equal(c1, c2)
+
+    def test_delta_quantiles_match_exact(self):
+        """Fixed seed, guard-admitted: 16/50/84 quantiles of the delta
+        chain agree with the exact chain well within the posterior width."""
+        parfile, prior, t, y, yerr = _problem()
+        c_d, s_d = _run(parfile, prior, t, y, yerr, mcmc_delta=1, steps=300)
+        c_e, s_e = _run(parfile, prior, t, y, yerr, mcmc_delta=0, steps=300)
+        for k in KEYS:
+            width = s_e[k]["plus"] + s_e[k]["minus"]
+            assert abs(s_d[k]["median"] - s_e[k]["median"]) < 0.2 * width
+            assert abs(s_d[k]["plus"] - s_e[k]["plus"]) < 0.35 * width
+            assert abs(s_d[k]["minus"] - s_e[k]["minus"]) < 0.35 * width
+
+    def test_guard_trip_falls_back_bitwise(self, obs_on):
+        """A guard-refused delta request must produce the knob-off bits
+        and count the fallback in the manifest."""
+        widths = {"F0": 1e3, "F1": 1.0, "GLF0_1": 1e3}
+        parfile, prior, t, y, yerr = _problem(widths=widths)
+        c_off, _ = _run(parfile, prior, t, y, yerr, mcmc_delta=0)
+        with obs.run("mcmc_guard"):
+            c_on, _ = _run(parfile, prior, t, y, yerr, mcmc_delta=1)
+        np.testing.assert_array_equal(c_on, c_off)
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["counters"]["mcmc_guard_fallbacks"] == 1
+        # a guard trip is a refusal, not a failure: the run is NOT degraded
+        assert doc["degraded"] is False
+
+    def test_chaos_nan_fault_degrades_to_exact(self, obs_on, monkeypatch):
+        """An injected NONFINITE_RESULT at mcmc_step steps the mcmc ladder
+        to the exact-likelihood rung: manifest stamped, chain bitwise the
+        knob-off chain."""
+        parfile, prior, t, y, yerr = _problem()
+        c_off, _ = _run(parfile, prior, t, y, yerr, mcmc_delta=0)
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "nan:mcmc_step:1")
+        faultinject.reset()
+        with obs.run("mcmc_chaos"):
+            c_on, _ = _run(parfile, prior, t, y, yerr, mcmc_delta=1)
+        np.testing.assert_array_equal(c_on, c_off)
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["degraded"] is True
+        assert doc["counters"]["degraded_mcmc_exact_likelihood"] == 1
+        assert "mcmc:exact_likelihood:nonfinite_result" in doc["degradations"]
+
+    def test_delta_path_counts_steps(self, obs_on):
+        parfile, prior, t, y, yerr = _problem()
+        with obs.run("mcmc_delta_counts"):
+            _run(parfile, prior, t, y, yerr, mcmc_delta=1, steps=60)
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["counters"]["mcmc_delta_path_steps"] == 60
+        assert doc["counters"]["mcmc_proposals_evaluated"] == 60 * 16
+
+    def test_no_retrace_across_runs(self):
+        """Satellite regression: a second run_mcmc with fresh same-shape
+        data must reuse the compiled ensemble cores on BOTH paths (the old
+        closure-per-run API retraced every call)."""
+        from crimp_tpu.utils.profiling import compile_counters
+
+        parfile, prior, t, y, yerr = _problem()
+        for delta in (0, 1):
+            _run(parfile, prior, t, y, yerr, mcmc_delta=delta)  # warm
+            before = compile_counters()["backend_compile_s"]
+            y2 = y + 1e-4  # fresh arrays, same shapes/structure
+            _run(parfile, prior, t, y2, yerr, mcmc_delta=delta)
+            after = compile_counters()["backend_compile_s"]
+            assert after == before, f"mcmc_delta={delta} retraced"
+
+
+class TestMultisourcePosteriors:
+    def _problems(self, sizes=(30, 45, 60), seed=2, noise=1e-3):
+        from crimp_tpu.ops import deltafold
+
+        rng = np.random.default_rng(seed)
+        truths = [np.array([2e-9 * (i + 1), -1e-16 * (i + 1)])
+                  for i in range(len(sizes))]
+        span = 2.0e6  # seconds
+        out = []
+        for n, tr in zip(sizes, truths):
+            dt = np.sort(rng.uniform(-span / 2, span / 2, n))
+            basis = np.asarray(deltafold.taylor_basis_seconds(dt, 2))
+            y = basis @ tr + rng.normal(0.0, noise, n)
+            out.append({
+                "basis": basis, "y": y - y.mean(), "err": np.full(n, noise),
+                "lo": np.array([-1e-8, -1e-15]),
+                "hi": np.array([1e-8, 1e-15]),
+            })
+        return out, truths
+
+    def test_ragged_batch_recovers_truths(self):
+        from crimp_tpu.ops import multisource
+
+        problems, truths = self._problems()
+        chains, lps = multisource.sample_posterior_sources(
+            problems, steps=600, walkers=12, seed=0)
+        assert chains.shape == (3, 600, 12, 2)
+        assert np.isfinite(lps).all()
+        for b, tr in enumerate(truths):
+            flat = chains[b, 200:].reshape(-1, 2)
+            med = np.median(flat, axis=0)
+            spread = flat.std(axis=0)
+            assert np.all(np.abs(med - tr) < 4 * spread)
+
+    def test_chunk_invariant_bits(self, monkeypatch):
+        """Chunking the source axis must not change a single bit: the
+        padded width is the batch-global max either way, and walker init +
+        PRNG streams are functions of (seed, source index) alone."""
+        from crimp_tpu.ops import multisource
+
+        problems, _ = self._problems()
+        whole, _ = multisource.sample_posterior_sources(
+            problems, steps=60, walkers=8, seed=3)
+        monkeypatch.setattr(multisource, "_resolve_chunk", lambda *a: 1)
+        chunked, _ = multisource.sample_posterior_sources(
+            problems, steps=60, walkers=8, seed=3)
+        np.testing.assert_array_equal(whole, chunked)
+
+    def test_empty_and_mismatched_ndim(self):
+        from crimp_tpu.ops import multisource
+
+        chains, lps = multisource.sample_posterior_sources([], 10, 4)
+        assert chains.shape[0] == 0
+        problems, _ = self._problems()
+        problems[1] = dict(problems[1], basis=problems[1]["basis"][:, :1],
+                           lo=problems[1]["lo"][:1], hi=problems[1]["hi"][:1])
+        with pytest.raises(ValueError, match="share ndim"):
+            multisource.sample_posterior_sources(problems, 10, 4)
