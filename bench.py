@@ -578,6 +578,10 @@ def bench_grid_mxu(times: np.ndarray, n_trials: int = 100_000,
     out: dict = {
         "nharm": nharm, "n_fdot": n_fdot, "reseed": reseed,
         "dev_budget_frac": GRID_MXU_DEV_BUDGET,
+        # cube-size metadata: ledger rounds at different grid shapes must
+        # never be compared as like-for-like
+        "n_trials": int(n_trials),
+        "grid_shape": [int(n_fdot), int(n_trials) // int(n_fdot)],
     }
     rate_1d, p_exact = rate_of(
         lambda: search.z2_power_grid(sec, f0, df, n_trials, nharm, mxu=False))
@@ -629,6 +633,171 @@ def bench_grid_mxu(times: np.ndarray, n_trials: int = 100_000,
     log(f"[bench] grid_mxu gate: promoted={promoted} "
         f"(>1.2x both + dev under {GRID_MXU_DEV_BUDGET} + argmax identical)")
     return out
+
+
+def bench_jerk(times: np.ndarray, n_freq: int = 500, n_fdot: int = 2,
+               n_fddot: int = 2, n_fddot_coh: int = 8, n_segments: int = 4,
+               nharm: int = 2, persist: bool = True) -> dict:
+    """The search-cube A/B pair: factorized-vs-exact 3-D jerk grids and
+    semi-coherent-vs-coherent stacking.
+
+    Gate 1 (grid_mxu-shaped promotion): the factorized 3-D kernel must
+    beat the exact per-tile-scan cube by >1.2x with max statistic
+    deviation under 1% of sqrt(4*nharm) and an IDENTICAL argmax; only
+    then does the winner persist through autotune.store_grid3d_mxu.
+
+    Gate 2 (matched-coverage throughput): the semi-coherent stack scans
+    the same (f, fdot) plane with the fddot axis collapsed from
+    ``n_fddot_coh`` coherent trials to ``n_fddot_coh / n_segments``
+    per-segment trials — the classic stack-slide trade (ops/semicoherent).
+    Both sides are quoted in EQUIVALENT-COHERENT cube trials/s
+    (n_freq * n_fdot * n_fddot_coh per wall), so ``trials_per_s`` — the
+    ledger-gated headline — compares like-for-like coverage.
+    """
+    from crimp_tpu.ops import autotune, search, semicoherent
+
+    sec = (times - times.mean()) * 86400.0
+    freqs = np.linspace(0.1430, 0.1436, n_freq)
+    f0, df = search.uniform_grid(freqs)
+    fdots = -(10.0 ** np.linspace(-14.5, -13.5, n_fdot))
+    fddots = np.linspace(-1e-20, 1e-20, n_fddot)
+    reseed = autotune.GRID_MXU_RESEED_DEFAULT
+    noise_scale = float(np.sqrt(4 * nharm))
+    n_cube = n_freq * n_fdot * n_fddot
+
+    def rate_of(fn, n_trials):
+        np.asarray(fn())  # compile
+        t0 = time.perf_counter()
+        power = np.asarray(fn())
+        return n_trials / (time.perf_counter() - t0), power
+
+    out: dict = {
+        "nharm": nharm, "reseed": reseed,
+        "dev_budget_frac": GRID_MXU_DEV_BUDGET,
+        "n_trials": int(n_cube),
+        "grid_shape": [int(n_fddot), int(n_fdot), int(n_freq)],
+        "n_segments": int(n_segments),
+    }
+    # --- gate 1: factorized vs exact 3-D cube -----------------------------
+    rate_3d, p_exact = rate_of(
+        lambda: search.z2_power_3d_grid(sec, f0, df, n_freq, fdots, fddots,
+                                        nharm, mxu=False), n_cube)
+    rate_3d_mxu, p_mxu = rate_of(
+        lambda: search.z2_power_3d_grid(sec, f0, df, n_freq, fdots, fddots,
+                                        nharm, mxu=True, reseed=reseed,
+                                        mxu_bf16=False), n_cube)
+    out["trials_per_sec_3d_exact"] = rate_3d
+    out["trials_per_sec_3d_mxu"] = rate_3d_mxu
+    out["dev_frac_3d"] = float(np.max(np.abs(p_mxu - p_exact))) / noise_scale
+    out["argmax_identical_3d"] = bool(np.argmax(p_mxu) == np.argmax(p_exact))
+    log(f"[bench] jerk 3-D: exact {rate_3d:.0f} vs factorized "
+        f"{rate_3d_mxu:.0f} trials/s, dev {out['dev_frac_3d']:.2e} of noise")
+    promoted = bool(
+        rate_3d_mxu > GRID_MXU_SPEEDUP_GATE * rate_3d
+        and out["dev_frac_3d"] < GRID_MXU_DEV_BUDGET
+        and out["argmax_identical_3d"]
+    )
+    out["promoted"] = promoted
+    out["persisted"] = False
+    if persist:
+        try:
+            autotune.store_grid3d_mxu(False, len(sec), n_cube, {
+                "grid_mxu": int(promoted), "reseed": reseed, "mxu_bf16": 0,
+                "trials_per_sec_exact": round(rate_3d, 1),
+                "trials_per_sec_mxu": round(rate_3d_mxu, 1),
+            })
+            out["persisted"] = True
+        except Exception as exc:  # noqa: BLE001 - persistence is best-effort
+            log(f"[bench] grid3d_mxu winner not persisted: {exc}")
+    log(f"[bench] jerk gate: promoted={promoted} (> {GRID_MXU_SPEEDUP_GATE}x "
+        f"+ dev under {GRID_MXU_DEV_BUDGET} + argmax identical)")
+
+    # --- gate 2: semi-coherent vs coherent at matched coverage ------------
+    n_fddot_semi = max(1, n_fddot_coh // n_segments)
+    fdd_coh = np.linspace(-1e-20, 1e-20, n_fddot_coh)
+    fdd_semi = np.linspace(-1e-20, 1e-20, n_fddot_semi)
+    equiv_trials = n_freq * n_fdot * n_fddot_coh
+    rate_coh, _ = rate_of(
+        lambda: search.z2_power_3d_grid(sec, f0, df, n_freq, fdots, fdd_coh,
+                                        nharm, mxu=False), equiv_trials)
+    rate_semi, _ = rate_of(
+        lambda: semicoherent.semicoherent_z2_grid(
+            sec, f0, df, n_freq, fdots, fdd_semi, nharm=nharm,
+            n_segments=n_segments, mxu=False), equiv_trials)
+    out["equiv_trials"] = int(equiv_trials)
+    out["n_fddot_coherent"] = int(n_fddot_coh)
+    out["n_fddot_semicoherent"] = int(n_fddot_semi)
+    out["trials_per_sec_coherent"] = rate_coh
+    out["trials_per_sec_semicoherent"] = rate_semi
+    out["semicoherent_advantage"] = bool(rate_semi > rate_coh)
+    # the ledger-gated headline: the surviving (faster) engine's rate at
+    # matched coverage
+    out["trials_per_s"] = max(rate_semi, rate_coh)
+    log(f"[bench] jerk semi-coherent A/B at matched coverage "
+        f"({n_fddot_coh} coherent vs {n_segments}x{n_fddot_semi} stacked "
+        f"fddot trials): coherent {rate_coh:.0f} vs semi-coherent "
+        f"{rate_semi:.0f} equivalent trials/s "
+        f"(advantage={out['semicoherent_advantage']})")
+    return out
+
+
+def jerk_main(argv=None) -> int:
+    """``python bench.py bench_jerk`` — standalone search-cube bench.
+
+    Separate from :func:`main` like the serving bench: it opens its own
+    flight-recorder run and appends its own ledger record (with the
+    ``trials_per_s`` headline the ledger gates). Exit status reports the
+    gate: 0 when the factorized 3-D kernel promotes AND the semi-coherent
+    stack shows a measured advantage at matched coverage, 1 otherwise.
+    """
+    import argparse
+
+    from crimp_tpu import obs
+    from crimp_tpu.obs import ledger as obs_ledger
+
+    ap = argparse.ArgumentParser(prog="bench.py bench_jerk")
+    ap.add_argument("--events", type=int, default=200_000)
+    ap.add_argument("--n-freq", type=int, default=500)
+    ap.add_argument("--n-fdot", type=int, default=2)
+    ap.add_argument("--n-fddot", type=int, default=2)
+    ap.add_argument("--n-fddot-coh", type=int, default=8)
+    ap.add_argument("--segments", type=int, default=4)
+    ap.add_argument("--no-persist", action="store_true")
+    args = ap.parse_args(argv)
+
+    import os
+
+    from crimp_tpu import knobs
+
+    platform_forced = bool(knobs.env_str("CRIMP_TPU_BENCH_PLATFORM")) or \
+        os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+    platform = choose_platform()
+    # synthetic event stream in MJD days (~1 month span), same shape the
+    # in-round bench feeds bench_grid_mxu from the surrogate
+    rng = np.random.RandomState(7)
+    days = np.sort(rng.uniform(0.0, 30.0, args.events))
+    with obs.run("bench_jerk", platform=platform) as obs_run:
+        res = bench_jerk(days, n_freq=args.n_freq, n_fdot=args.n_fdot,
+                         n_fddot=args.n_fddot, n_fddot_coh=args.n_fddot_coh,
+                         n_segments=args.segments,
+                         persist=not args.no_persist)
+    record = {
+        "metric": "jerk_search_throughput",
+        "unit": "trials/s",
+        "platform": platform,
+        "platform_fallback": platform == "cpu" and not platform_forced,
+        "trials_per_s": round(res["trials_per_s"], 1),
+        "grid_shape": res["grid_shape"],
+        "n_trials": res["n_trials"],
+        "jerk_ab": res,
+        "obs_manifest": obs.last_manifest_path() if obs_run is not None
+        else None,
+    }
+    print(json.dumps(record), flush=True)
+    path = obs_ledger.append_bench_record(record, source="bench.py bench_jerk")
+    if path:
+        log(f"[bench] ledger: jerk record appended to {path}")
+    return 0 if (res["promoted"] and res["semicoherent_advantage"]) else 1
 
 
 def bench_delta_fold(par_path: str, times: np.ndarray, intervals,
@@ -1436,7 +1605,7 @@ def main():
 
     errors: dict[str, str] = {}
     # the step() call sites below, in order — heartbeat denominators
-    n_stages = 10  # surrogate warmup z2 grid_mxu delta_fold mcmc multisource toas north_star config4
+    n_stages = 11  # surrogate warmup z2 grid_mxu jerk delta_fold mcmc multisource toas north_star config4
     stages_done = [0]
 
     def step(name: str, fn, *args, **kwargs):
@@ -1495,6 +1664,11 @@ def main():
 
     grid_mxu = step("grid_mxu", bench_grid_mxu, times,
                     n_trials=z2_trials, n_fdot=4 if on_cpu else 8)
+
+    jerk = step("jerk", bench_jerk, times,
+                n_freq=max(z2_trials // 4, 64),
+                n_fdot=2, n_fddot=2,
+                n_fddot_coh=8, n_segments=4)
 
     delta_fold = step("delta_fold", bench_delta_fold, par, times, intervals)
 
@@ -1584,6 +1758,14 @@ def main():
         # dense-vs-factorized grid kernel A/B (1-D and 2-D) with its
         # promotion gate; the gated winner persists in the autotune cache
         "grid_mxu_ab": grid_mxu,
+        # search-cube A/B pair (factorized 3-D jerk grid + semi-coherent
+        # stacking at matched coverage); trials_per_s is the ledger-gated
+        # equivalent-coherent cube throughput (obs/ledger.py METRICS)
+        "jerk_ab": jerk,
+        "trials_per_s": (
+            round(jerk["trials_per_s"], 1)
+            if jerk and jerk.get("trials_per_s") else None
+        ),
         # exact-vs-delta refold A/B (ops/deltafold.py) with its promotion
         # gate (>2x + deviation under 1% of the per-ToA error bar + off
         # path bit-stable); the gated winner persists in the autotune cache
@@ -1647,4 +1829,6 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "bench_serving":
         sys.exit(serving_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "bench_jerk":
+        sys.exit(jerk_main(sys.argv[2:]))
     main()

@@ -172,6 +172,14 @@ def cached_blocks(kernel: str, poly: bool, n_events: int, n_trials: int) -> tupl
 
 # -- resolution -------------------------------------------------------------
 
+# The kernel families resolve_blocks() tunes, in one place so CLI sweeps
+# (scripts/sweep_blocks.py derives its --kernel choices from this) can
+# never silently miss a newly added family. "grid3d" is the jerk-search
+# cube and "semicoherent" the segment-stacked cube engine; both share the
+# grid static defaults and the CRIMP_TPU_GRID_BLOCKS override.
+BLOCK_KERNELS = ("grid", "grid_mxu", "grid3d", "semicoherent", "general",
+                 "multisource")
+
 
 def static_defaults(kernel: str) -> tuple[int, int]:
     from crimp_tpu.ops import search
@@ -217,7 +225,7 @@ def resolve_blocks(kernel: str, n_events: int, n_trials: int,
     miss (only when CRIMP_TPU_AUTOTUNE=1) > static module defaults.
     Never runs timing unless eager mode is opted into.
     """
-    if kernel not in ("grid", "grid_mxu", "general", "multisource"):
+    if kernel not in BLOCK_KERNELS:
         raise ValueError(f"unknown kernel variant {kernel!r}")
     if event_block is not None and trial_block is not None:
         return int(event_block), int(trial_block)
@@ -403,6 +411,64 @@ def resolve_grid_mxu(n_events: int, n_trials: int, poly: bool = False) -> dict:
             logger.warning("grid_mxu autotune cache lookup failed (%s); using "
                            "static defaults", resilience.classify(exc).value,
                            exc_info=True)
+            cached = None
+        _count_cache(bool(cached))
+        if cached:
+            out.update(cached)
+    if env_m is not None:
+        out["grid_mxu"] = env_m
+    if env_b is not None:
+        out["mxu_bf16"] = env_b
+    return out
+
+
+def grid3d_mxu_cache_key(poly: bool, n_events: int, n_trials: int,
+                         platform: str | None = None,
+                         device_kind: str | None = None) -> str:
+    """Cache key for the 3-D cube's factorized-path winner. The kernel
+    name "grid3d_mxu_enable" keeps it collision-free against both the
+    "grid3d" block entries and the 2-D "grid_mxu_enable" entries (the 3-D
+    kernel's win threshold is measured separately, by bench_jerk)."""
+    return cache_key("grid3d_mxu_enable", poly, n_events, n_trials,
+                     platform=platform, device_kind=device_kind)
+
+
+def cached_grid3d_mxu(poly: bool, n_events: int, n_trials: int) -> dict | None:
+    entry = _load_cache().get(grid3d_mxu_cache_key(poly, n_events, n_trials))
+    if not isinstance(entry, dict):
+        return None
+    m, r, b = entry.get("grid_mxu"), entry.get("reseed"), entry.get("mxu_bf16")
+    if m in (0, 1) and isinstance(r, int) and r > 0 and b in (0, 1):
+        return {"grid_mxu": m, "reseed": r, "mxu_bf16": b}
+    return None
+
+
+def store_grid3d_mxu(poly: bool, n_events: int, n_trials: int, entry: dict,
+                     path: pathlib.Path | None = None) -> None:
+    """Persist a gated grid3d A/B winner (bench.py bench_jerk calls this)."""
+    _store_entry(grid3d_mxu_cache_key(poly, n_events, n_trials), entry, path)
+
+
+def resolve_grid3d_mxu(n_events: int, n_trials: int,
+                       poly: bool = False) -> dict:
+    """Resolve {grid_mxu, reseed, mxu_bf16} for the 3-D search cube.
+
+    Same precedence as resolve_grid_mxu — CRIMP_TPU_GRID_MXU is the ONE
+    shared hard override for every factorized grid kernel (no separate
+    3-D env knob) > cached bench_jerk A/B winner > default off; only the
+    accuracy-gated bench ever caches a 1.
+    """
+    out = grid_mxu_defaults()
+    env_m = _env_nonneg_int(GRID_MXU_ENV, valid=(0, 1))
+    env_b = _env_nonneg_int(MXU_BF16_ENV, valid=(0, 1))
+    if autotune_mode() != "off":
+        try:
+            cached = cached_grid3d_mxu(poly, n_events, n_trials)
+        except Exception as exc:  # noqa: BLE001 — a corrupt cache or an
+            # uninitializable backend must never take down a search call
+            logger.warning("grid3d_mxu autotune cache lookup failed (%s); "
+                           "using static defaults",
+                           resilience.classify(exc).value, exc_info=True)
             cached = None
         _count_cache(bool(cached))
         if cached:

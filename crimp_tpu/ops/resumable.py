@@ -42,9 +42,10 @@ CHUNK_TRIALS = 50_000
 
 
 def _fingerprint(times: np.ndarray, freqs: np.ndarray, fdots: np.ndarray,
-                 nharm: int, chunk_trials: int) -> dict:
+                 nharm: int, chunk_trials: int, fddots=None,
+                 semicoherent: int = 0) -> dict:
     t = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
-    return {
+    fp = {
         # version is the KERNEL-SEMANTICS version: bump it whenever the
         # statistic computed per chunk changes meaning/precision, so chunks
         # from the old kernel can never mix into a post-fix result. v2:
@@ -70,35 +71,57 @@ def _fingerprint(times: np.ndarray, freqs: np.ndarray, fdots: np.ndarray,
         "nharm": int(nharm),
         "chunk_trials": int(chunk_trials),
     }
+    # 3-D / semi-coherent keys only when the scan uses them, so every 2-D
+    # store written before the cube kernels landed keeps its fingerprint
+    if fddots is not None:
+        fp["fddots"] = [float(f) for f in np.atleast_1d(fddots)]
+    if semicoherent:
+        fp["semicoherent"] = int(semicoherent)
+    return fp
 
 
 class ResumableScan:
     """Z^2_n over a (fdot x frequency) grid, checkpointed per trial chunk.
 
     ``fdots=None`` gives the 1-D scan (one all-zero fdot row, squeezed on
-    return). ``store=None`` disables checkpointing entirely (pure
+    return); ``fddots`` extends it to the (fddot x fdot x freq) cube
+    (chunks hold the flattened (n_fddot*n_fdot, k) rows; ``run`` returns
+    the cube), and ``semicoherent=S`` computes each cube chunk as the
+    S-segment incoherent stack (ops/semicoherent; uniform grid required).
+    ``store=None`` disables checkpointing entirely (pure
     chunked compute). Usage::
 
         scan = ResumableScan(times_sec, freqs, nharm=2, store="ckpt_dir")
         power = scan.run()      # computes missing chunks, returns (n_freq,)
     """
 
-    def __init__(self, times, freqs, nharm: int = 2, fdots=None,
+    def __init__(self, times, freqs, nharm: int = 2, fdots=None, fddots=None,
                  store: str | None = None, chunk_trials: int = CHUNK_TRIALS,
-                 poly: bool | None = None, statistic: str = "z2"):
+                 poly: bool | None = None, statistic: str = "z2",
+                 semicoherent: int = 0):
         if statistic not in ("z2", "h"):
             raise ValueError(f"statistic must be 'z2' or 'h', got {statistic!r}")
-        if statistic == "h" and fdots is not None:
-            raise ValueError("the H-test scan is 1-D (fdots unsupported)")
+        if statistic == "h" and (fdots is not None or fddots is not None):
+            raise ValueError("the H-test scan is 1-D (fdots/fddots unsupported)")
+        if semicoherent and fddots is None:
+            raise ValueError(
+                "semicoherent stacking is the cube scan's mode (pass fddots)")
         self.times = np.asarray(times, dtype=np.float64)
         self.freqs = np.asarray(freqs, dtype=np.float64)
         self.nharm = int(nharm)
         self.statistic = statistic
-        self._squeeze = fdots is None
+        self._squeeze = fdots is None and fddots is None
         self.fdots = np.zeros(1) if fdots is None else np.atleast_1d(
             np.asarray(fdots, dtype=np.float64))
+        self.fddots = None if fddots is None else np.atleast_1d(
+            np.asarray(fddots, dtype=np.float64))
+        self.semicoherent = int(semicoherent)
         self.chunk_trials = int(chunk_trials)
         from crimp_tpu.ops import fasttrig, search
+
+        if self.semicoherent and search.uniform_grid(self.freqs) is None:
+            raise ValueError(
+                "semi-coherent scans need a uniform frequency grid")
 
         # Resolve every numeric-mode knob NOW and pin it in the store
         # fingerprint: chunks computed under different trig/precision modes
@@ -120,9 +143,17 @@ class ResumableScan:
         self._mxu_explicit = autotune._env_nonneg_int(
             autotune.GRID_MXU_ENV, valid=(0, 1)) is not None
         if self._fastpath:
-            r = autotune.resolve_grid_mxu(
-                len(self.times), min(len(self.freqs), self.chunk_trials),
-                poly=self.poly)
+            n_tr = min(len(self.freqs), self.chunk_trials)
+            if self.fddots is not None:
+                # cube scans bucket the knob at the per-chunk CUBE trial
+                # count — the workload the bench_jerk A/B actually gated
+                r = autotune.resolve_grid3d_mxu(
+                    len(self.times),
+                    n_tr * len(self.fdots) * len(self.fddots),
+                    poly=self.poly)
+            else:
+                r = autotune.resolve_grid_mxu(len(self.times), n_tr,
+                                              poly=self.poly)
             self._mxu = bool(r["grid_mxu"])
             self._mxu_reseed = int(r["reseed"])
             self._mxu_bf16 = bool(r["mxu_bf16"])
@@ -131,7 +162,8 @@ class ResumableScan:
             self._mxu_reseed = autotune.GRID_MXU_RESEED_DEFAULT
             self._mxu_bf16 = False
         if self._fastpath:
-            kernel = "grid_mxu" if self._mxu else "grid"
+            kernel = "grid_mxu" if self._mxu else (
+                "grid3d" if self.fddots is not None else "grid")
         else:
             kernel = "general"
         self._blocks = autotune.resolve_blocks(
@@ -174,7 +206,8 @@ class ResumableScan:
 
     def _open_store(self) -> None:
         fp = _fingerprint(self.times, self.freqs, self.fdots, self.nharm,
-                          self.chunk_trials)
+                          self.chunk_trials, fddots=self.fddots,
+                          semicoherent=self.semicoherent)
         fp["statistic"] = self.statistic
         fp["numeric_mode"] = self._numeric_mode
         manifest = self.store / "manifest.json"
@@ -295,7 +328,12 @@ class ResumableScan:
         from crimp_tpu.ops.search import MIN_SHARD_PAIRS
         from crimp_tpu.parallel import mesh as pmesh
 
+        if self.semicoherent:
+            # the semi-coherent stack drives its own per-segment dispatch
+            return None
         pairs = len(self.times) * n_trials_chunk * len(self.fdots)
+        if self.fddots is not None:
+            pairs *= len(self.fddots)
         if pairs < MIN_SHARD_PAIRS:
             return None
         return pmesh.auto_mesh()
@@ -333,6 +371,8 @@ class ResumableScan:
         lo = i * self.chunk_trials
         width = min(self.chunk_trials, len(self.freqs) - lo)
         n_rows = 1 if self.statistic == "h" else len(self.fdots)
+        if self.fddots is not None:
+            n_rows = len(self.fdots) * len(self.fddots)
         try:
             faultinject.fire("scan_chunk")
             arr = np.load(path, allow_pickle=False)
@@ -367,6 +407,42 @@ class ResumableScan:
         # the PINNED factorized-kernel mode (part of the store fingerprint)
         mx, rs, b16 = self._mxu, self._mxu_reseed, self._mxu_bf16
         mesh = self._mesh(len(chunk))
+        if self.fddots is not None:
+            # cube scan: (n_fddot * n_fdot, k) rows per chunk, flattened in
+            # the kernel's (fddot, fdot) row-major order; run() reshapes
+            k = len(chunk)
+            if self.semicoherent:
+                from crimp_tpu.ops import semicoherent as semi
+
+                grid = search.uniform_grid(self.freqs)
+                rows = semi.semicoherent_z2_grid(
+                    self.times, float(chunk[0]), grid[1], k, self.fdots,
+                    self.fddots, nharm=self.nharm,
+                    n_segments=self.semicoherent, poly=poly,
+                    event_block=eb, trial_block=tb, mxu=mx, reseed=rs,
+                    mxu_bf16=b16)
+                return rows.reshape(-1, k)
+            if mesh is not None:
+                from crimp_tpu.parallel import mesh as pmesh
+
+                rows = pmesh.z2_3d_sharded(
+                    self.times, chunk, self.fdots, self.fddots, self.nharm,
+                    mesh, use_fastpath=self._fastpath, poly=poly,
+                    use_mxu=mx, reseed=rs, mxu_bf16=b16)
+                return np.asarray(rows).reshape(-1, k)
+            if self._fastpath:
+                grid = search.uniform_grid(self.freqs)
+                rows = search.z2_power_3d_grid(
+                    self._times_device(), float(chunk[0]), grid[1], k,
+                    jnp.asarray(self.fdots), jnp.asarray(self.fddots),
+                    self.nharm, event_block=eb, trial_block=tb, poly=poly,
+                    mxu=mx, reseed=rs, mxu_bf16=b16)
+            else:
+                rows = search.z2_power_3d(
+                    self._times_device(), jnp.asarray(chunk),
+                    jnp.asarray(self.fdots), jnp.asarray(self.fddots),
+                    self.nharm, event_block=eb, trial_block=tb, poly=poly)
+            return rows.reshape(-1, k)
         if mesh is not None:
             from crimp_tpu.parallel import mesh as pmesh
 
@@ -489,4 +565,6 @@ class ResumableScan:
                 if pending is not None:
                     self._finish_chunk(pending[0], pending[1], parts, progress)
             power = np.concatenate(parts, axis=1)
+            if self.fddots is not None:
+                power = power.reshape(len(self.fddots), len(self.fdots), -1)
             return power[0] if self._squeeze else power

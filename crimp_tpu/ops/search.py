@@ -611,6 +611,223 @@ def z2_power_2d_grid(
 
 
 # ---------------------------------------------------------------------------
+# The (f, fdot, fddot) search cube — third-order (jerk) uniform-grid kernels
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block", "trial_block", "poly"))
+def harmonic_sums_uniform_3d(
+    times: jax.Array,
+    f0: float,
+    df: float,
+    n_freq: int,
+    fdots: jax.Array,
+    fddots: jax.Array,
+    nharm: int,
+    event_block: int = GRID_EVENT_BLOCK,
+    trial_block: int = GRID_TRIAL_BLOCK,
+    weights: jax.Array | None = None,
+    poly: bool = False,
+):
+    """Trig sums over the (fddot x fdot x uniform-frequency) search cube,
+    sharing the f64 rows across ALL THREE grid axes
+    -> (n_fddot, n_fdot, nharm, n_freq) each.
+
+    The jerk-search phase at (fddot_l, fdot_i, trial j = j0 + j_lo) splits
+    into four terms:
+
+        f_j*t + fd_i*t^2/2 + fdd_l*t^3/6
+            = [f_tile*t] + [fd_i*t^2/2] + [fdd_l*t^3/6] + j_lo*(df*t)
+
+    One f64 row per TILE, one per FDOT, one per FDDOT — the f64-emulated
+    work per event block is (n_tiles + n_fdot + n_fddot) rows instead of
+    the n_tiles*n_fdot*n_fddot rows of re-running the 2-D kernel once per
+    fddot. Each reduced term lies in [-0.5, 0.5); summing three of them in
+    f32 adds ~3 ulp (~1.8e-7 cycles) on top of the fast path's
+    trial_block/2 * 2^-24 budget, and _harmonic_sums_cycles re-reduces
+    before trig. The cubic row's f64 rounding is harmless: t^3 can exceed
+    2^53 at long baselines, but its RELATIVE error (~1e-16) is scaled by
+    fdd*t^3/6 cycles, i.e. far below a micro-cycle for any physical jerk.
+    With fddots == [0.0] the cubic row is exactly zero and the result is
+    bit-identical to harmonic_sums_uniform_2d (the association
+    (row_t + row_q) + row_r preserves the 2-D sum).
+    """
+    time_blocks, weight_blocks = _block_times(times, event_block, weights)
+    n_tiles = -(-n_freq // trial_block)
+    j_lo = jnp.arange(trial_block, dtype=jnp.float32)
+    b_blocks = fasttrig.centered_frac(df * time_blocks).astype(jnp.float32)
+    f_tiles = f0 + (jnp.arange(n_tiles, dtype=jnp.float64) * trial_block) * df
+    fd = jnp.asarray(fdots, dtype=jnp.float64)
+    fdd = jnp.asarray(fddots, dtype=jnp.float64)
+    n_fdot = fd.shape[0]
+    n_fddot = fdd.shape[0]
+    if n_fdot == 0 or n_fddot == 0:  # static at trace time; empty -> empty
+        empty = jnp.zeros((n_fddot, n_fdot, nharm, n_freq), jnp.float64)
+        return empty, empty
+
+    # Anchor the carry to the traced operands (shard_map varying axes).
+    anchor = 0.0 * (time_blocks[0, 0] + f_tiles[0] + jnp.sum(fd) + jnp.sum(fdd))
+    zeros = jnp.zeros((n_fddot, n_fdot, n_tiles, nharm, trial_block),
+                      jnp.float64) + anchor
+
+    def step(carry, blk):
+        t_blk, w_blk, b_blk = blk
+        row_t = fasttrig.centered_frac(
+            f_tiles[:, None] * t_blk[None, :]).astype(jnp.float32)       # (n_tiles, EB)
+        row_q = fasttrig.centered_frac(
+            (0.5 * fd)[:, None] * (t_blk * t_blk)[None, :]).astype(jnp.float32)  # (n_fdot, EB)
+        row_r = fasttrig.centered_frac(
+            (fdd / 6.0)[:, None] * (t_blk * t_blk * t_blk)[None, :]
+        ).astype(jnp.float32)                                            # (n_fddot, EB)
+        w32 = w_blk.astype(jnp.float32)
+
+        def per_fddot(r_row):
+            def per_fdot(q_row):
+                def per_tile(t_row):
+                    phase32 = ((t_row + q_row) + r_row)[None, :] \
+                        + j_lo[:, None] * b_blk[None, :]
+                    return _harmonic_sums_cycles(
+                        phase32, w32[None, :], nharm, jnp.float32, poly
+                    )
+                return jax.lax.map(per_tile, row_t)  # (n_tiles, nharm, TB) x2
+            return jax.lax.map(per_fdot, row_q)      # (n_fdot, n_tiles, nharm, TB) x2
+
+        c, s = jax.lax.map(per_fddot, row_r)
+        return (carry[0] + c, carry[1] + s), None
+
+    (c_sum, s_sum), _ = jax.lax.scan(
+        step, (zeros, zeros), (time_blocks, weight_blocks, b_blocks)
+    )
+    c_all = jnp.moveaxis(c_sum, 3, 2).reshape(
+        n_fddot, n_fdot, nharm, -1)[:, :, :, :n_freq]
+    s_all = jnp.moveaxis(s_sum, 3, 2).reshape(
+        n_fddot, n_fdot, nharm, -1)[:, :, :, :n_freq]
+    return c_all, s_all
+
+
+def _grid3d_sums_dispatch(times, f0, df, n_freq, fdots, fddots, nharm, poly,
+                          event_block, trial_block, mxu, reseed, mxu_bf16,
+                          weights=None):
+    """(c, s, n_events) for the 3-D cube wrappers.
+
+    Same resolution discipline as _grid_sums_dispatch — factorized knob
+    explicit > CRIMP_TPU_GRID_MXU > cached "grid3d" A/B winner > off,
+    blocks through the autotuner under the "grid3d" key — and the same
+    grid resilience ladder: a dead MXU rung drops to the streamed
+    exact-sincos kernel, then to the in-core exact kernel. ``weights``
+    (per-event validity, e.g. semi-coherent segment masks) skips the
+    streamed rung because the streamed driver derives its own
+    chunk-validity weights.
+    """
+    n = np.shape(times)[0]
+    fd = jnp.asarray(fdots, dtype=jnp.float64)
+    fdd = jnp.asarray(fddots, dtype=jnp.float64)
+    n_cube = int(n_freq) * int(fd.shape[0]) * int(fdd.shape[0])
+    use_mxu, rs, b16 = _resolve_grid3d_mxu(n, n_cube, poly, mxu, reseed,
+                                           mxu_bf16)
+    eb, tb = resolve_blocks("grid_mxu" if use_mxu else "grid3d", n, n_freq,
+                            poly, event_block, trial_block)
+    obs.counter_add("grid_trials", n_cube)
+    dev_times = jnp.asarray(times)
+    if use_mxu:
+        try:
+            faultinject.fire("harmonic_sums")
+            # one exact-sincos reseed row per `rs` trials per cube row
+            obs.counter_add(
+                "grid_mxu_reseeds",
+                -(-int(n_freq) // max(1, int(rs)))
+                * int(fd.shape[0]) * int(fdd.shape[0]))
+            c, s = harmonic_sums_uniform_3d_mxu(
+                dev_times, f0, df, n_freq, fd, fdd, nharm, eb, tb,
+                weights=weights, poly=poly, reseed=rs, mxu_bf16=b16)
+            costmodel.capture("grid_sums_3d_mxu", harmonic_sums_uniform_3d_mxu,
+                              dev_times, f0, df, n_freq, fd, fdd, nharm,
+                              eb, tb, weights=weights, poly=poly, reseed=rs,
+                              mxu_bf16=b16)
+            return c, s, n
+        except Exception as exc:  # noqa: BLE001 — grid ladder (see 1-D twin)
+            kind = resilience.classify(exc)
+            eb, tb = resolve_blocks("grid3d", n, n_freq, poly, event_block,
+                                    trial_block)
+            if weights is None:
+                try:
+                    resilience.record_degradation("grid", "streamed", kind)
+                    c, s = _streamed_uniform_sums(times, f0, df, n_freq,
+                                                  nharm, eb, tb, poly,
+                                                  fdots=fd, fddots=fdd)
+                    return c, s, n
+                except Exception as exc2:  # noqa: BLE001 — last rung: exact
+                    resilience.record_degradation("grid", "exact",
+                                                  resilience.classify(exc2))
+            else:
+                resilience.record_degradation("grid", "exact", kind)
+    else:
+        faultinject.fire("harmonic_sums")
+    c, s = harmonic_sums_uniform_3d(
+        dev_times, f0, df, n_freq, fd, fdd, nharm, eb, tb,
+        weights=weights, poly=poly)
+    costmodel.capture("grid_sums_3d", harmonic_sums_uniform_3d,
+                      dev_times, f0, df, n_freq, fd, fdd, nharm, eb, tb,
+                      weights=weights, poly=poly)
+    return c, s, n
+
+
+def z2_power_3d_grid(
+    times,
+    f0: float,
+    df: float,
+    n_freq: int,
+    fdots,
+    fddots,
+    nharm: int = 2,
+    event_block: int | None = None,
+    trial_block: int | None = None,
+    poly: bool = False,
+    mxu: bool | None = None,
+    reseed: int | None = None,
+    mxu_bf16: bool | None = None,
+) -> jax.Array:
+    """Z^2_n over the (fddot x fdot x uniform-frequency) search cube
+    -> (n_fddot, n_fdot, n_freq).
+
+    Built on harmonic_sums_uniform_3d: the per-tile, per-fdot and
+    per-fddot f64 rows are each shared across the other two grid axes.
+    ``fdots``/``fddots`` are SIGNED Hz/s and Hz/s^2. ``mxu`` selects the
+    factorized matmul kernel (explicit > CRIMP_TPU_GRID_MXU > cached
+    grid3d A/B winner > off).
+    """
+    c, s, n = _grid3d_sums_dispatch(times, f0, df, n_freq, fdots, fddots,
+                                    nharm, poly, event_block, trial_block,
+                                    mxu, reseed, mxu_bf16)
+    return jnp.sum(z2_from_sums(c, s, n), axis=2)
+
+
+def h_power_3d_grid(
+    times,
+    f0: float,
+    df: float,
+    n_freq: int,
+    fdots,
+    fddots,
+    nharm: int = 20,
+    event_block: int | None = None,
+    trial_block: int | None = None,
+    poly: bool = False,
+    mxu: bool | None = None,
+    reseed: int | None = None,
+    mxu_bf16: bool | None = None,
+) -> jax.Array:
+    """H-test over the (fddot x fdot x uniform-frequency) search cube
+    -> (n_fddot, n_fdot, n_freq)."""
+    c, s, n = _grid3d_sums_dispatch(times, f0, df, n_freq, fdots, fddots,
+                                    nharm, poly, event_block, trial_block,
+                                    mxu, reseed, mxu_bf16)
+    z2_cum = jnp.cumsum(z2_from_sums(c, s, n), axis=2)
+    penalties = 4.0 * jnp.arange(nharm, dtype=jnp.float64)[None, None, :, None]
+    return jnp.max(z2_cum - penalties, axis=2)
+
+
+# ---------------------------------------------------------------------------
 # Factorized (matmul) uniform-grid kernels — the CRIMP_TPU_GRID_MXU path
 # ---------------------------------------------------------------------------
 #
@@ -868,6 +1085,105 @@ def harmonic_sums_uniform_2d_mxu(
     return c_all, s_all
 
 
+def _mxu_3d_step(f_tiles, fd, fdd, nharm, n_tiles, trial_block, poly,
+                 reseed, mxu_bf16):
+    """Per-event-block scan body of the factorized 3-D kernel (shared by
+    the monolithic kernel and the streamed carry update)."""
+    n_fdot = fd.shape[0]
+    n_fddot = fdd.shape[0]
+
+    def step(carry, blk):
+        t_blk, w_blk, b_blk = blk
+        row_t = fasttrig.centered_frac(
+            f_tiles[:, None] * t_blk[None, :]).astype(jnp.float32)
+        row_q = fasttrig.centered_frac(
+            (0.5 * fd)[:, None] * (t_blk * t_blk)[None, :]).astype(jnp.float32)
+        row_r = fasttrig.centered_frac(
+            (fdd / 6.0)[:, None] * (t_blk * t_blk * t_blk)[None, :]
+        ).astype(jnp.float32)
+        ct, st = _trig_rows(row_t, poly)               # (n_tiles, EB)
+        cq, sq = _trig_rows(row_q, poly)               # (n_fdot, EB)
+        cr, sr = _trig_rows(row_r, poly)               # (n_fddot, EB)
+        # two angle additions: tile (+) fdot, then (+) fddot — the cube's
+        # base-phase trig costs n_tiles + n_fdot + n_fddot transcendental
+        # rows while the matmul's M axis stacks n_fddot*n_fdot*n_tiles rows
+        c_qt = (cq[:, None, :] * ct[None, :, :]
+                - sq[:, None, :] * st[None, :, :])     # (n_fdot, n_tiles, EB)
+        s_qt = (sq[:, None, :] * ct[None, :, :]
+                + cq[:, None, :] * st[None, :, :])
+        c0 = (cr[:, None, None, :] * c_qt[None, :, :, :]
+              - sr[:, None, None, :] * s_qt[None, :, :, :]
+              ).reshape(n_fddot * n_fdot * n_tiles, -1)
+        s0 = (sr[:, None, None, :] * c_qt[None, :, :, :]
+              + cr[:, None, None, :] * s_qt[None, :, :, :]
+              ).reshape(n_fddot * n_fdot * n_tiles, -1)
+        csw, ssw = _sweep_matrices(b_blk, trial_block, reseed, poly)
+        ck, sk = _factored_harmonic_sums(
+            c0, s0, w_blk.astype(jnp.float32), csw, ssw, nharm, mxu_bf16)
+        ck = ck.reshape(nharm, n_fddot, n_fdot, n_tiles, trial_block)
+        sk = sk.reshape(nharm, n_fddot, n_fdot, n_tiles, trial_block)
+        return (carry[0] + ck, carry[1] + sk), None
+
+    return step
+
+
+@partial(jax.jit, static_argnames=("n_freq", "nharm", "event_block",
+                                   "trial_block", "poly", "reseed", "mxu_bf16"))
+def harmonic_sums_uniform_3d_mxu(
+    times: jax.Array,
+    f0: float,
+    df: float,
+    n_freq: int,
+    fdots: jax.Array,
+    fddots: jax.Array,
+    nharm: int,
+    event_block: int = GRID_EVENT_BLOCK,
+    trial_block: int = GRID_TRIAL_BLOCK,
+    weights: jax.Array | None = None,
+    poly: bool = False,
+    reseed: int = GRID_MXU_RESEED,
+    mxu_bf16: bool = False,
+    tile0: int | jax.Array = 0,
+):
+    """Factorized (matmul) twin of :func:`harmonic_sums_uniform_3d`.
+
+    Same contract and output shapes (n_fddot, n_fdot, nharm, n_freq). The
+    cube is where the factorization pays the most: the dense path's
+    transcendental count is one sin/cos pair per (fddot, fdot, tile,
+    trial, event) while here it is O((n_tiles + n_fdot + n_fddot +
+    TB/reseed)*EB) per event block — the third grid axis costs ONE extra
+    angle-addition combine, and the event reduction runs as
+    n_fddot*n_fdot*n_tiles-row matmuls (deeper MXU work than the 2-D
+    kernel at the same trial count). ``tile0`` offsets the tile index for
+    sharded callers exactly as in harmonic_sums_uniform_2d_mxu.
+    """
+    time_blocks, weight_blocks = _block_times(times, event_block, weights)
+    n_tiles = -(-n_freq // trial_block)
+    b_blocks = fasttrig.centered_frac(df * time_blocks).astype(jnp.float32)
+    tiles = (jnp.asarray(tile0, jnp.float64)
+             + jnp.arange(n_tiles, dtype=jnp.float64))
+    f_tiles = f0 + (tiles * trial_block) * df
+    fd = jnp.asarray(fdots, dtype=jnp.float64)
+    fdd = jnp.asarray(fddots, dtype=jnp.float64)
+    n_fdot = fd.shape[0]
+    n_fddot = fdd.shape[0]
+    if n_fdot == 0 or n_fddot == 0:  # static at trace time; empty -> empty
+        empty = jnp.zeros((n_fddot, n_fdot, nharm, n_freq), jnp.float64)
+        return empty, empty
+    anchor = 0.0 * (time_blocks[0, 0] + f_tiles[0] + jnp.sum(fd) + jnp.sum(fdd))
+    zeros = jnp.zeros((nharm, n_fddot, n_fdot, n_tiles, trial_block),
+                      jnp.float64) + anchor
+    step = _mxu_3d_step(f_tiles, fd, fdd, nharm, n_tiles, trial_block, poly,
+                        reseed, mxu_bf16)
+    (c_sum, s_sum), _ = jax.lax.scan(
+        step, (zeros, zeros), (time_blocks, weight_blocks, b_blocks))
+    c_all = jnp.moveaxis(c_sum, 0, 2).reshape(
+        n_fddot, n_fdot, nharm, -1)[:, :, :, :n_freq]
+    s_all = jnp.moveaxis(s_sum, 0, 2).reshape(
+        n_fddot, n_fdot, nharm, -1)[:, :, :, :n_freq]
+    return c_all, s_all
+
+
 def _resolve_grid_mxu(n_events: int, n_trials: int, poly: bool,
                       mxu: bool | None, reseed: int | None,
                       mxu_bf16: bool | None) -> tuple[bool, int, bool]:
@@ -882,6 +1198,28 @@ def _resolve_grid_mxu(n_events: int, n_trials: int, poly: bool,
     from crimp_tpu.ops import autotune
 
     r = autotune.resolve_grid_mxu(n_events, n_trials, poly=poly)
+    use = bool(r["grid_mxu"]) if mxu is None else bool(mxu)
+    rs = int(r["reseed"]) if reseed is None else int(reseed)
+    b16 = bool(r["mxu_bf16"]) if mxu_bf16 is None else bool(mxu_bf16)
+    return use, rs, b16
+
+
+def _resolve_grid3d_mxu(n_events: int, n_trials: int, poly: bool,
+                        mxu: bool | None, reseed: int | None,
+                        mxu_bf16: bool | None) -> tuple[bool, int, bool]:
+    """(use_mxu, reseed, mxu_bf16) for the 3-D cube wrappers.
+
+    Same discipline as _resolve_grid_mxu, but the cached A/B winner lives
+    under the autotune "grid3d" family (its win is gated by bench.py
+    bench_jerk against the exact 3-D kernel, not by the 1-D/2-D A/B).
+    CRIMP_TPU_GRID_MXU stays the one shared hard override for every
+    factorized grid kernel — no separate 3-D env knob.
+    """
+    if mxu is not None and reseed is not None and mxu_bf16 is not None:
+        return bool(mxu), int(reseed), bool(mxu_bf16)
+    from crimp_tpu.ops import autotune
+
+    r = autotune.resolve_grid3d_mxu(n_events, n_trials, poly=poly)
     use = bool(r["grid_mxu"]) if mxu is None else bool(mxu)
     rs = int(r["reseed"]) if reseed is None else int(reseed)
     b16 = bool(r["mxu_bf16"]) if mxu_bf16 is None else bool(mxu_bf16)
@@ -1056,6 +1394,80 @@ def _grid2d_stream_update_mxu(nharm: int, n_tiles: int, event_block: int,
     return jax.jit(update, donate_argnums=(0, 1) if donate else ())
 
 
+@lru_cache(maxsize=None)
+def _grid3d_stream_update(nharm: int, n_tiles: int, event_block: int,
+                          trial_block: int, poly: bool, donate: bool):
+    """Jitted carry update for one streamed chunk of the 3-D cube kernel
+    (same replay-the-monolithic-scan-body contract as _grid_stream_update)."""
+
+    def update(c, s, chunk_times, n_valid, f0, df, fdots, fddots):
+        time_blocks = chunk_times.reshape(-1, event_block)
+        w = (jnp.arange(chunk_times.shape[0]) < n_valid).astype(jnp.float64)
+        weight_blocks = w.reshape(-1, event_block)
+        b_blocks = fasttrig.centered_frac(df * time_blocks).astype(jnp.float32)
+        j_lo = jnp.arange(trial_block, dtype=jnp.float32)
+        f_tiles = f0 + (jnp.arange(n_tiles, dtype=jnp.float64) * trial_block) * df
+        fd = jnp.asarray(fdots, dtype=jnp.float64)
+        fdd = jnp.asarray(fddots, dtype=jnp.float64)
+
+        def step(carry, blk):
+            t_blk, w_blk, b_blk = blk
+            row_t = fasttrig.centered_frac(
+                f_tiles[:, None] * t_blk[None, :]).astype(jnp.float32)
+            row_q = fasttrig.centered_frac(
+                (0.5 * fd)[:, None] * (t_blk * t_blk)[None, :]).astype(jnp.float32)
+            row_r = fasttrig.centered_frac(
+                (fdd / 6.0)[:, None] * (t_blk * t_blk * t_blk)[None, :]
+            ).astype(jnp.float32)
+            w32 = w_blk.astype(jnp.float32)
+
+            def per_fddot(r_row):
+                def per_fdot(q_row):
+                    def per_tile(t_row):
+                        phase32 = ((t_row + q_row) + r_row)[None, :] \
+                            + j_lo[:, None] * b_blk[None, :]
+                        return _harmonic_sums_cycles(
+                            phase32, w32[None, :], nharm, jnp.float32, poly
+                        )
+                    return jax.lax.map(per_tile, row_t)
+                return jax.lax.map(per_fdot, row_q)
+
+            ck, sk = jax.lax.map(per_fddot, row_r)
+            return (carry[0] + ck, carry[1] + sk), None
+
+        (c1, s1), _ = jax.lax.scan(
+            step, (c, s), (time_blocks, weight_blocks, b_blocks)
+        )
+        return c1, s1
+
+    return jax.jit(update, donate_argnums=(0, 1) if donate else ())
+
+
+@lru_cache(maxsize=None)
+def _grid3d_stream_update_mxu(nharm: int, n_tiles: int, event_block: int,
+                              trial_block: int, poly: bool, reseed: int,
+                              mxu_bf16: bool, donate: bool):
+    """Jitted carry update for one streamed chunk of the factorized 3-D
+    kernel (same replay-the-monolithic-scan-body contract as
+    _grid_stream_update_mxu)."""
+
+    def update(c, s, chunk_times, n_valid, f0, df, fdots, fddots):
+        time_blocks = chunk_times.reshape(-1, event_block)
+        w = (jnp.arange(chunk_times.shape[0]) < n_valid).astype(jnp.float64)
+        weight_blocks = w.reshape(-1, event_block)
+        b_blocks = fasttrig.centered_frac(df * time_blocks).astype(jnp.float32)
+        f_tiles = f0 + (jnp.arange(n_tiles, dtype=jnp.float64) * trial_block) * df
+        fd = jnp.asarray(fdots, dtype=jnp.float64)
+        fdd = jnp.asarray(fddots, dtype=jnp.float64)
+        step = _mxu_3d_step(f_tiles, fd, fdd, nharm, n_tiles, trial_block,
+                            poly, reseed, mxu_bf16)
+        (c1, s1), _ = jax.lax.scan(
+            step, (c, s), (time_blocks, weight_blocks, b_blocks))
+        return c1, s1
+
+    return jax.jit(update, donate_argnums=(0, 1) if donate else ())
+
+
 def _stream_chunks(times: np.ndarray, event_block: int, event_chunk: int):
     """Host-side chunk plan: [(padded_chunk, n_valid), ...].
 
@@ -1087,7 +1499,8 @@ def _stream_chunks(times: np.ndarray, event_block: int, event_chunk: int):
 
 
 def _streamed_uniform_sums(times, f0, df, n_freq, nharm, event_block,
-                           trial_block, poly, fdots=None, event_chunk=None,
+                           trial_block, poly, fdots=None, fddots=None,
+                           event_chunk=None,
                            mxu: bool = False, reseed: int = GRID_MXU_RESEED,
                            mxu_bf16: bool = False):
     """Double-buffered driver shared by the streamed grid kernels.
@@ -1118,6 +1531,15 @@ def _streamed_uniform_sums(times, f0, df, n_freq, nharm, event_block,
                 dev_times, f0, df, n_freq, nharm, event_block, trial_block,
                 poly=poly)
         fd = jnp.asarray(fdots, dtype=jnp.float64)
+        if fddots is not None:
+            fdd = jnp.asarray(fddots, dtype=jnp.float64)
+            if mxu:
+                return harmonic_sums_uniform_3d_mxu(
+                    dev_times, f0, df, n_freq, fd, fdd, nharm, event_block,
+                    trial_block, poly=poly, reseed=reseed, mxu_bf16=mxu_bf16)
+            return harmonic_sums_uniform_3d(
+                dev_times, f0, df, n_freq, fd, fdd,
+                nharm, event_block, trial_block, poly=poly)
         if mxu:
             return harmonic_sums_uniform_2d_mxu(
                 dev_times, f0, df, n_freq, fd, nharm, event_block,
@@ -1137,6 +1559,21 @@ def _streamed_uniform_sums(times, f0, df, n_freq, nharm, event_block,
                                          trial_block, poly, donate)
             carry_shape = (n_tiles, nharm, trial_block)
         extra = (0.0,)
+    elif fddots is not None:
+        fdots = jnp.asarray(fdots, dtype=jnp.float64)
+        fddots = jnp.asarray(fddots, dtype=jnp.float64)
+        n_fdot = int(fdots.shape[0])
+        n_fddot = int(fddots.shape[0])
+        if mxu:
+            update = _grid3d_stream_update_mxu(nharm, n_tiles, event_block,
+                                               trial_block, poly, reseed,
+                                               mxu_bf16, donate)
+            carry_shape = (nharm, n_fddot, n_fdot, n_tiles, trial_block)
+        else:
+            update = _grid3d_stream_update(nharm, n_tiles, event_block,
+                                           trial_block, poly, donate)
+            carry_shape = (n_fddot, n_fdot, n_tiles, nharm, trial_block)
+        extra = (fdots, fddots)
     else:
         fdots = jnp.asarray(fdots, dtype=jnp.float64)
         n_fdot = int(fdots.shape[0])
@@ -1168,6 +1605,17 @@ def _streamed_uniform_sums(times, f0, df, n_freq, nharm, event_block,
         else:
             c_all = jnp.moveaxis(c, 1, 0).reshape(nharm, -1)[:, :n_freq]
             s_all = jnp.moveaxis(s, 1, 0).reshape(nharm, -1)[:, :n_freq]
+    elif fddots is not None:
+        if mxu:
+            c_all = jnp.moveaxis(c, 0, 2).reshape(
+                n_fddot, n_fdot, nharm, -1)[:, :, :, :n_freq]
+            s_all = jnp.moveaxis(s, 0, 2).reshape(
+                n_fddot, n_fdot, nharm, -1)[:, :, :, :n_freq]
+        else:
+            c_all = jnp.moveaxis(c, 3, 2).reshape(
+                n_fddot, n_fdot, nharm, -1)[:, :, :, :n_freq]
+            s_all = jnp.moveaxis(s, 3, 2).reshape(
+                n_fddot, n_fdot, nharm, -1)[:, :, :, :n_freq]
     elif mxu:
         c_all = jnp.moveaxis(c, 0, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
         s_all = jnp.moveaxis(s, 0, 1).reshape(n_fdot, nharm, -1)[:, :, :n_freq]
@@ -1233,6 +1681,29 @@ def z2_power_2d_grid_streamed(
     return jnp.sum(z2_from_sums(c, s, n), axis=1)
 
 
+def z2_power_3d_grid_streamed(
+    times, f0: float, df: float, n_freq: int, fdots, fddots, nharm: int = 2,
+    event_block: int | None = None, trial_block: int | None = None,
+    poly: bool = False, event_chunk: int | None = None,
+    mxu: bool | None = None, reseed: int | None = None,
+    mxu_bf16: bool | None = None,
+) -> jax.Array:
+    """z2_power_3d_grid with double-buffered host->device event streaming."""
+    n = np.shape(times)[0]
+    fd = jnp.asarray(fdots, dtype=jnp.float64)
+    fdd = jnp.asarray(fddots, dtype=jnp.float64)
+    n_cube = int(n_freq) * int(fd.shape[0]) * int(fdd.shape[0])
+    use_mxu, rs, b16 = _resolve_grid3d_mxu(n, n_cube, poly, mxu, reseed,
+                                           mxu_bf16)
+    eb, tb = resolve_blocks("grid_mxu" if use_mxu else "grid3d", n, n_freq,
+                            poly, event_block, trial_block)
+    c, s = _streamed_uniform_sums(times, f0, df, n_freq, nharm, eb, tb, poly,
+                                  fdots=fd, fddots=fdd,
+                                  event_chunk=event_chunk,
+                                  mxu=use_mxu, reseed=rs, mxu_bf16=b16)
+    return jnp.sum(z2_from_sums(c, s, n), axis=2)
+
+
 @partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype", "poly"))
 def z2_power_2d(
     times: jax.Array,
@@ -1260,6 +1731,40 @@ def z2_power_2d(
         return jnp.sum(z2_from_sums(c_sum, s_sum, times.shape[0]), axis=0)
 
     return jax.lax.map(one_fdot, fdots)
+
+
+@partial(jax.jit, static_argnames=("nharm", "event_block", "trial_block", "trig_dtype", "poly"))
+def z2_power_3d(
+    times: jax.Array,
+    freqs: jax.Array,
+    fdots: jax.Array,
+    fddots: jax.Array,
+    nharm: int = 2,
+    event_block: int = DEFAULT_EVENT_BLOCK,
+    trial_block: int = DEFAULT_TRIAL_BLOCK,
+    trig_dtype=DEFAULT_TRIG_DTYPE,
+    poly: bool = False,
+) -> jax.Array:
+    """Z^2_n over the (fddot, fdot, freq) cube -> (n_fddot, n_fdot, n_freq).
+
+    The arbitrary-frequency-grid fallback of the jerk search; both
+    derivative axes are SIGNED (Hz/s and Hz/s^2) as in z2_power_2d.
+    """
+
+    def one_fddot(fddot):
+        def one_fdot(fdot):
+            c_sum, s_sum = _blocked_trial_sums(
+                times, freqs, nharm, event_block, trial_block, trig_dtype,
+                lambda f_blk, t_blk: f_blk[:, None] * t_blk[None, :]
+                + 0.5 * fdot * t_blk[None, :] ** 2
+                + (fddot / 6.0) * t_blk[None, :] ** 3,
+                poly=poly,
+            )
+            return jnp.sum(z2_from_sums(c_sum, s_sum, times.shape[0]), axis=0)
+
+        return jax.lax.map(one_fdot, fdots)
+
+    return jax.lax.map(one_fddot, fddots)
 
 
 @partial(jax.jit, static_argnames=("nharm", "trig_dtype"))
@@ -1464,3 +1969,96 @@ class PeriodSearch:
         )
         df = pd.DataFrame(rows, columns=["Freq", "Freq_dot", "Z2pow"])
         return rows, df
+
+    def _threed_rows(self, log_fdots, fdd, power):
+        """(rows, DataFrame) for the cube scans: outer fddot, then fdot,
+        then freq (extends the reference 2-D row ordering by one axis)."""
+        rows = np.column_stack(
+            [
+                np.tile(self.freq, len(log_fdots) * len(fdd)),
+                np.tile(np.repeat(log_fdots, len(self.freq)), len(fdd)),
+                np.repeat(fdd, len(self.freq) * len(log_fdots)),
+                np.asarray(power).reshape(-1),
+            ]
+        )
+        df = pd.DataFrame(
+            rows, columns=["Freq", "Freq_dot", "Freq_ddot", "Z2pow"])
+        return rows, df
+
+    def threed_ztest(self, freq_dot, freq_ddot):
+        """3-D Z^2 over the (freq x log10 |nudot| x signed nuddot) cube.
+
+        ``freq_dot`` keeps twod_ztest's reference convention (log10
+        magnitudes, applied as -10**x, spin-down only); ``freq_ddot`` is
+        SIGNED s^-3 — the jerk axis has no reference convention and
+        braking/anti-braking cubes are genuinely two-signed (see
+        docs/parity.md). Returns (rows, DataFrame) ordered outer fddot,
+        then fdot, then freq.
+        """
+        log_fdots = np.asarray(freq_dot, dtype=np.float64)
+        signed = -(10.0**log_fdots)
+        fdd = np.asarray(freq_ddot, dtype=np.float64)
+        n_cube = len(self.freq) * len(signed) * len(fdd)
+        with obs.span("z2_3d_scan", n_trials=n_cube,
+                      n_events=len(self.time), nharm=self.nbrHarm):
+            mesh = self._mesh(len(self.time) * n_cube)
+            if mesh is not None:
+                from crimp_tpu.parallel import mesh as pmesh
+
+                power = pmesh.z2_3d_sharded(
+                    self.time - self.t0, self.freq, signed, fdd,
+                    self.nbrHarm, mesh,
+                    use_fastpath=self.use_grid_fastpath, poly=self._poly(),
+                )
+            elif (grid := self._grid()) is not None:
+                f0, df = grid
+                power = np.asarray(
+                    z2_power_3d_grid(
+                        self._centered(), f0, df, len(self.freq),
+                        jnp.asarray(signed), jnp.asarray(fdd), self.nbrHarm,
+                        poly=self._poly(),
+                    )
+                )
+            else:
+                eb, tb = self._general_blocks()
+                power = np.asarray(
+                    z2_power_3d(
+                        self._centered(),
+                        jnp.asarray(self.freq),
+                        jnp.asarray(signed),
+                        jnp.asarray(fdd),
+                        self.nbrHarm,
+                        event_block=eb,
+                        trial_block=tb,
+                        poly=self._poly(),
+                    )
+                )
+        return self._threed_rows(log_fdots, fdd, power)
+
+    def semicoherent_ztest(self, freq_dot, freq_ddot, n_segments: int):
+        """Semi-coherent stacked Z^2 over the cube (ops/semicoherent).
+
+        Events are split into ``n_segments`` equal-duration segments, each
+        scanned coherently at the GLOBAL phase model, and the per-segment
+        Z^2 terms are summed incoherently — so the fddot grid only needs
+        per-segment resolution (~n_segments x coarser than the coherent
+        cube; docs/performance.md "Search cube"). Same axis conventions
+        and row ordering as threed_ztest; requires a uniform frequency
+        grid (the stack runs on the grid fast path).
+        """
+        from crimp_tpu.ops import semicoherent
+
+        grid = uniform_grid(self.freq)
+        if grid is None:
+            raise ValueError(
+                "semicoherent_ztest needs a uniform frequency grid")
+        f0, df = grid
+        log_fdots = np.asarray(freq_dot, dtype=np.float64)
+        signed = -(10.0**log_fdots)
+        fdd = np.asarray(freq_ddot, dtype=np.float64)
+        power = semicoherent.semicoherent_z2_grid(
+            self.time - self.t0, f0, df, len(self.freq), signed, fdd,
+            nharm=self.nbrHarm, n_segments=int(n_segments),
+            poly=self._poly(),
+        )
+        return self._threed_rows(log_fdots, fdd, power)

@@ -1,0 +1,236 @@
+"""Semi-coherent stacked searches over the (f, fdot, fddot) cube.
+
+The coherent cube kernel (ops/search.py harmonic_sums_uniform_3d) pays for
+fddot resolution proportional to T_obs^3: the phase drift a trial must track
+grows with the CUBE of the coherent span. Splitting T_obs into S
+equal-duration segments, scanning each coherently at the GLOBAL phase model,
+and summing the per-segment Z^2 terms incoherently keeps the (f, fdot)
+sensitivity while the fddot spacing needed to keep each SEGMENT phase-
+coherent coarsens by ~S^2 relative to the coherent cube (classic stack-slide
+/ Hough tradeoff, astro-ph/0112006) — so a matched-coverage scan runs with
+~S^2 fewer fddot trials at the cost of a sqrt(S)-ish sensitivity haircut.
+
+Numeric contract (docs/parity.md):
+
+- every per-segment statistic is computed at the EXACT global phase model —
+  segment times are NOT re-centered, so a stack with ``fddots=[0.0]`` probes
+  the same trial family as the coherent kernels;
+- ``stack="incoherent"`` sums per-segment Z^2 in fixed segment order and is
+  BITWISE-identical to a hand-written per-segment loop over the same padded
+  rows (pinned in tests/test_semicoherent.py);
+- ``stack="coherent"`` sums the per-segment trig sums (a pure re-blocking of
+  the event reduction) and matches the monolithic coherent kernel to
+  reduction-order tolerance — the identity the stacking parity test leans on.
+
+Per-segment work runs through search._grid3d_sums_dispatch with the segment
+validity mask as event weights, so the MXU factorization, block autotuning
+and the grid resilience ladder all apply per segment; every segment row is
+padded to one common length and the kernel compiles ONCE for the whole
+stack.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from crimp_tpu import obs
+from crimp_tpu.ops import search
+
+
+def split_segments(times, n_segments: int):
+    """Pad ``times`` into ``n_segments`` equal-DURATION rows + 0/1 weights.
+
+    Returns (seg_times, seg_weights), both (S, Nmax) f64; rows are padded
+    with zeros carrying zero weight. Segments are equal spans of the
+    observation (np.linspace edges), not equal event counts — the phase
+    model is a function of time, so duration is what bounds per-segment
+    coherence loss. ``times`` must be sorted (the reference event lists
+    are); raises ValueError otherwise.
+    """
+    t = np.asarray(times, dtype=np.float64)
+    n_segments = int(n_segments)
+    if n_segments < 1:
+        raise ValueError(f"n_segments must be >= 1, got {n_segments}")
+    if t.ndim != 1 or t.size == 0:
+        raise ValueError("split_segments needs a non-empty 1-D time array")
+    if np.any(np.diff(t) < 0):
+        raise ValueError("split_segments needs time-sorted events")
+    edges = np.linspace(t[0], t[-1], n_segments + 1)
+    # searchsorted on interior edges: each event lands in exactly one
+    # segment; the final edge is inclusive by construction
+    bounds = np.searchsorted(t, edges[1:-1], side="left")
+    starts = np.concatenate([[0], bounds])
+    stops = np.concatenate([bounds, [t.size]])
+    n_max = max(1, int(np.max(stops - starts)))
+    seg_times = np.zeros((n_segments, n_max), dtype=np.float64)
+    seg_weights = np.zeros((n_segments, n_max), dtype=np.float64)
+    for i, (lo, hi) in enumerate(zip(starts, stops)):
+        seg_times[i, : hi - lo] = t[lo:hi]
+        seg_weights[i, : hi - lo] = 1.0
+    return seg_times, seg_weights
+
+
+def stacked_sums_grid(seg_times, seg_weights, f0, df, n_freq, fdots, fddots,
+                      nharm: int = 2, poly: bool = False,
+                      event_block: int | None = None,
+                      trial_block: int | None = None,
+                      mxu: bool | None = None, reseed: int | None = None,
+                      mxu_bf16: bool | None = None):
+    """Per-segment cube trig sums at the global phase model.
+
+    Returns (c, s, counts): c/s are (S, n_fddot, n_fdot, nharm, n_freq)
+    jax arrays, counts the (S,) valid-event totals. One python loop over
+    identically-shaped padded rows -> one kernel compile; each iteration
+    goes through the full grid dispatch (MXU knob, autotuned blocks,
+    resilience ladder), with the pad mask as event weights.
+    """
+    seg_times = np.asarray(seg_times, dtype=np.float64)
+    seg_weights = np.asarray(seg_weights, dtype=np.float64)
+    counts = seg_weights.sum(axis=1)
+    c_rows, s_rows = [], []
+    for i in range(seg_times.shape[0]):
+        c, s, _ = search._grid3d_sums_dispatch(
+            seg_times[i], f0, df, n_freq, fdots, fddots, nharm, poly,
+            event_block, trial_block, mxu, reseed, mxu_bf16,
+            weights=jnp.asarray(seg_weights[i]),
+        )
+        c_rows.append(c)
+        s_rows.append(s)
+    return jnp.stack(c_rows), jnp.stack(s_rows), counts
+
+
+def semicoherent_z2_grid(times, f0, df, n_freq, fdots, fddots,
+                         nharm: int = 2, n_segments: int = 8,
+                         stack: str = "incoherent", poly: bool = False,
+                         event_block: int | None = None,
+                         trial_block: int | None = None,
+                         mxu: bool | None = None, reseed: int | None = None,
+                         mxu_bf16: bool | None = None, mesh=None):
+    """Stacked Z^2 over the uniform (fddot, fdot, freq) cube.
+
+    ``stack="incoherent"`` (the semi-coherent statistic) sums per-segment
+    Z^2 terms, each normalized by its own event count, in fixed segment
+    order; ``stack="coherent"`` sums the trig sums first (equivalent to the
+    monolithic coherent kernel up to reduction order — the parity bridge,
+    not a faster path). Returns a (n_fddot, n_fdot, n_freq) jax array.
+
+    Passing an explicit ``mesh`` routes the incoherent stack through the
+    segment-sharded kernel (parallel/mesh.semicoherent_stack_sharded);
+    cross-segment order then follows the shard-local-sum + psum schedule,
+    so sharded output is reduction-order-tolerant, not bitwise.
+    """
+    if stack not in ("incoherent", "coherent"):
+        raise ValueError(f"unknown stack mode {stack!r}")
+    seg_times, seg_weights = split_segments(times, n_segments)
+    n_cube = int(n_freq) * len(np.atleast_1d(fdots)) * len(np.atleast_1d(fddots))
+    obs.counter_add("semicoherent_segments", int(n_segments))
+    with obs.span("semicoherent_scan", n_trials=n_cube,
+                  n_segments=int(n_segments), n_events=int(np.size(times)),
+                  nharm=nharm, stack=stack):
+        if mesh is not None and stack == "incoherent":
+            from crimp_tpu.parallel import mesh as pmesh
+
+            n_dev = int(np.prod(list(mesh.shape.values())))
+            pad = (-len(seg_times)) % n_dev
+            if pad:
+                seg_times = np.pad(seg_times, ((0, pad), (0, 0)))
+                seg_weights = np.pad(seg_weights, ((0, pad), (0, 0)))
+            eb, tb = search.resolve_blocks(
+                "grid3d", seg_times.shape[1], n_freq, poly,
+                event_block, trial_block)
+            return pmesh.semicoherent_stack_sharded(
+                seg_times, seg_weights, f0, df, n_freq, fdots, fddots,
+                nharm, mesh, event_block=eb, trial_block=tb, poly=poly)
+        c, s, counts = stacked_sums_grid(
+            seg_times, seg_weights, f0, df, n_freq, fdots, fddots, nharm,
+            poly, event_block, trial_block, mxu, reseed, mxu_bf16)
+        if stack == "coherent":
+            c_tot = jnp.sum(c, axis=0)
+            s_tot = jnp.sum(s, axis=0)
+            return jnp.sum(
+                search.z2_from_sums(c_tot, s_tot, float(counts.sum())),
+                axis=2)
+        # fixed ascending segment order — the hand-loop bitwise contract
+        power = None
+        for i in range(c.shape[0]):
+            term = jnp.sum(
+                search.z2_from_sums(c[i], s[i], max(float(counts[i]), 1.0)),
+                axis=2)
+            power = term if power is None else power + term
+        return power
+
+
+def stacked_power_from_phases(phase_segments, nharm: int = 2,
+                              statistic: str = "z2",
+                              stack: str = "incoherent",
+                              poly: bool = False):
+    """Stacked Z^2/H from already-folded per-segment phases (cycles).
+
+    The glue for model-folded stacks (anchored.fold_segments output):
+    ragged per-segment phase lists are reduced per segment with the same
+    Chebyshev harmonic sums as the search kernels, then stacked. For
+    ``statistic="h"`` the H-test max-over-harmonics applies to the STACKED
+    per-harmonic Z^2 profile (the standard stacked-H definition). Returns
+    a scalar jax value.
+    """
+    if statistic not in ("z2", "h"):
+        raise ValueError(f"unknown statistic {statistic!r}")
+    if stack not in ("incoherent", "coherent"):
+        raise ValueError(f"unknown stack mode {stack!r}")
+    rows = [jnp.asarray(np.asarray(p, dtype=np.float64).ravel())
+            for p in phase_segments if np.size(p)]
+    if not rows:
+        raise ValueError("stacked_power_from_phases needs >= 1 non-empty segment")
+    per_harm = None  # (nharm,) stacked per-harmonic Z^2
+    c_tot = s_tot = None
+    n_tot = 0.0
+    for ph in rows:
+        c, s = search._harmonic_sums_cycles(
+            ph, jnp.ones_like(ph), nharm, poly=poly)
+        if stack == "coherent":
+            c_tot = c if c_tot is None else c_tot + c
+            s_tot = s if s_tot is None else s_tot + s
+            n_tot += float(ph.shape[0])
+        else:
+            term = search.z2_from_sums(c, s, float(ph.shape[0]))
+            per_harm = term if per_harm is None else per_harm + term
+    if stack == "coherent":
+        per_harm = search.z2_from_sums(c_tot, s_tot, n_tot)
+    if statistic == "z2":
+        return jnp.sum(per_harm)
+    z2_cum = jnp.cumsum(per_harm)
+    return jnp.max(z2_cum - 4.0 * jnp.arange(nharm, dtype=jnp.float64))
+
+
+def segment_h_from_model(timMod, seg_times, nharm: int = 5,
+                         t_ref_mjd=None, delta_fold=None,
+                         cache_tag: str | None = None,
+                         row_block: int | None = None):
+    """Per-segment H-test of a timing model: fold_segments -> stacked rows.
+
+    Folds each segment's events through the anchored fold (delta-fold
+    engine eligible), pads the ragged phase lists into one (S, Nmax)
+    batch and scores every segment with h_power_segments_chunked at
+    frequency 1.0 (the phases are already cycle-folded). Empty segments
+    score 0.0. Returns a (S,) numpy array — the per-segment coherence
+    diagnostic for choosing a semi-coherent segmentation.
+    """
+    from crimp_tpu.ops import anchored
+
+    seg_phase, _ = anchored.fold_segments(
+        timMod, seg_times, t_ref_mjd=t_ref_mjd, delta_fold=delta_fold,
+        cache_tag=cache_tag)
+    sizes = [np.size(p) for p in seg_phase]
+    n_max = max(1, max(sizes, default=1))
+    ph = np.zeros((len(seg_phase), n_max), dtype=np.float64)
+    mask = np.zeros((len(seg_phase), n_max), dtype=np.float64)
+    for i, p in enumerate(seg_phase):
+        ph[i, : sizes[i]] = np.asarray(p, dtype=np.float64)
+        mask[i, : sizes[i]] = 1.0
+    out = search.h_power_segments_chunked(
+        ph, mask, np.ones(len(seg_phase), dtype=np.float64),
+        nharm=nharm, row_block=row_block)
+    out = np.array(out)  # owning copy: np.asarray of a jax array is read-only
+    out[np.asarray(sizes) == 0] = 0.0
+    return out

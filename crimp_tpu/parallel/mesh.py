@@ -73,10 +73,13 @@ from crimp_tpu.ops.search import (
     GRID_MXU_RESEED,
     GRID_TRIAL_BLOCK,
     _blocked_trial_sums,
+    _resolve_grid3d_mxu,
     _resolve_grid_mxu,
     grid_fastpath_enabled,
     harmonic_sums_uniform_2d,
     harmonic_sums_uniform_2d_mxu,
+    harmonic_sums_uniform_3d,
+    harmonic_sums_uniform_3d_mxu,
     resolve_blocks,
     uniform_grid,
     z2_from_sums,
@@ -396,6 +399,179 @@ def z2_2d_sharded(
     c, s = _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype,
                             use_fastpath, poly, use_mxu, reseed, mxu_bf16)
     return np.asarray(jnp.sum(z2_from_sums(c, s, len(times)), axis=1))  # graftlint: disable=GL005 (sums the replicated nharm axis, not the sharded event axis; per-trial order is fixed and the 8-device bitwise pin covers it)
+
+
+def _sharded_sums_grid3d(
+    times,
+    weights,
+    f0: float,
+    df: float,
+    n_freq: int,
+    fdots,
+    fddots,
+    nharm: int,
+    mesh: Mesh,
+    event_block: int = GRID_EVENT_BLOCK,
+    trial_block: int = GRID_TRIAL_BLOCK,
+    poly: bool = False,
+    mxu: bool = False,
+    reseed: int = GRID_MXU_RESEED,
+    mxu_bf16: bool = False,
+):
+    """Uniform-grid 3-D cube trig sums under sharding.
+
+    Same contract as :func:`_sharded_sums_grid` extended with a replicated
+    fddot axis: each trial tile owns a contiguous frequency range, fdots and
+    fddots are replicated, and the f64 psum combine over the event axis is
+    identical to the monolithic kernel's cross-block scan order.
+    """
+    tr_size = mesh.shape[TRIAL_AXIS]
+    n_freq_shard = n_freq // tr_size
+
+    def kernel(t_shard, w_shard, fd_all, fdd_all):
+        tile = jax.lax.axis_index(TRIAL_AXIS)
+        f0_shard = f0 + (tile * n_freq_shard) * df
+        if mxu and n_freq_shard % trial_block == 0:
+            # GLOBAL f0 plus the shard's first tile index keeps the f_tiles
+            # rounding bitwise-equal to the monolithic kernel (see the 2-D
+            # sharded kernel for the reasoning)
+            c_all, s_all = harmonic_sums_uniform_3d_mxu(
+                t_shard, f0, df, n_freq_shard, fd_all, fdd_all, nharm,
+                event_block, trial_block, weights=w_shard, poly=poly,
+                reseed=reseed, mxu_bf16=mxu_bf16,
+                tile0=tile * (n_freq_shard // trial_block),
+            )
+        elif mxu:
+            c_all, s_all = harmonic_sums_uniform_3d_mxu(
+                t_shard, f0_shard, df, n_freq_shard, fd_all, fdd_all, nharm,
+                event_block, trial_block, weights=w_shard, poly=poly,
+                reseed=reseed, mxu_bf16=mxu_bf16,
+            )
+        else:
+            c_all, s_all = harmonic_sums_uniform_3d(
+                t_shard, f0_shard, df, n_freq_shard, fd_all, fdd_all, nharm,
+                event_block, trial_block, weights=w_shard, poly=poly,
+            )
+        return jax.lax.psum(c_all, EVENT_AXIS), jax.lax.psum(s_all, EVENT_AXIS)
+
+    plan = specs_for("sharded_sums_grid3d", mesh)
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=plan.in_specs("times", "weights", "fdots", "fddots"),
+        out_specs=plan.out_specs,
+    )(times, weights, fdots, fddots)
+
+
+def z2_3d_sharded(
+    times, freqs, fdots, fddots, nharm: int = 2, mesh: Mesh | None = None,
+    use_fastpath: bool | None = None, poly: bool = False,
+    use_mxu: bool | None = None, reseed: int | None = None,
+    mxu_bf16: bool | None = None,
+) -> np.ndarray:
+    """Z^2_n over the (fddot, fdot, freq) cube, events sharded across the
+    mesh. Requires the uniform-grid fast path; a non-uniform frequency list
+    falls back to the single-device general cube kernel (there is no general
+    sharded kernel with a cubic phase family)."""
+    if mesh is None:
+        mesh = build_mesh()
+    grid = None
+    if grid_fastpath_enabled(nharm, use_fastpath):
+        grid = uniform_grid(freqs)
+    if grid is None:
+        from crimp_tpu.ops import search as _search
+
+        obs.counter_add("mesh_grid3d_fallbacks")
+        eb, tb = resolve_blocks("general", len(times), len(freqs), poly)
+        power = _search.z2_power_3d(
+            jnp.asarray(np.asarray(times, dtype=np.float64)),
+            jnp.asarray(np.asarray(freqs, dtype=np.float64)),
+            jnp.asarray(np.atleast_1d(np.asarray(fdots, dtype=np.float64))),
+            jnp.asarray(np.atleast_1d(np.asarray(fddots, dtype=np.float64))),
+            nharm, event_block=eb, trial_block=tb, poly=poly,
+        )
+        return np.asarray(power)
+    f0, df = grid
+    ev_size = mesh.shape[EVENT_AXIS]
+    tr_size = mesh.shape[TRIAL_AXIS]
+    obs.counter_add("mesh_sharded_calls")
+    obs.gauge_set("mesh_devices", ev_size * tr_size)
+    n_freq = len(freqs)
+    t_pad, w_pad = _pad_to(np.asarray(times, dtype=np.float64), ev_size)
+    fd = jnp.asarray(np.atleast_1d(np.asarray(fdots, dtype=np.float64)))
+    fdd = jnp.asarray(np.atleast_1d(np.asarray(fddots, dtype=np.float64)))
+    ev_per_shard = len(t_pad) // ev_size
+    tr_per_shard = -(-n_freq // tr_size)
+    n_freq_pad = tr_per_shard * tr_size
+    # knob + block resolution at shard scale, exactly like _sharded_sums_nd
+    mx, rs, b16 = _resolve_grid3d_mxu(
+        ev_per_shard, tr_per_shard * len(fd) * len(fdd), poly,
+        use_mxu, reseed, mxu_bf16)
+    g_eb, g_tb = resolve_blocks("grid_mxu" if mx else "grid3d",
+                                ev_per_shard, tr_per_shard, poly)
+    gargs = (jnp.asarray(t_pad), jnp.asarray(w_pad), f0, df, n_freq_pad,
+             fd, fdd, nharm, mesh)
+    gkw = dict(event_block=_fit_block(g_eb, ev_per_shard),
+               trial_block=_fit_block(g_tb, tr_per_shard),
+               poly=poly, mxu=mx, reseed=rs, mxu_bf16=b16)
+    c, s = _sharded_sums_grid3d(*gargs, **gkw)
+    costmodel.capture("sharded_sums_grid3d", _sharded_sums_grid3d, *gargs,
+                      plan=specs_for("sharded_sums_grid3d", mesh), **gkw)
+    c, s = c[:, :, :, :n_freq], s[:, :, :, :n_freq]
+    return np.asarray(jnp.sum(z2_from_sums(c, s, len(times)), axis=2))  # graftlint: disable=GL005 (sums the replicated nharm axis, not the sharded event axis; per-trial order is fixed and the 8-device bitwise pin covers it)
+
+
+def semicoherent_stack_sharded(
+    seg_times, seg_weights, f0: float, df: float, n_freq: int,
+    fdots, fddots, nharm: int, mesh: Mesh | None = None,
+    event_block: int = GRID_EVENT_BLOCK, trial_block: int = GRID_TRIAL_BLOCK,
+    poly: bool = False,
+):
+    """Incoherently stacked per-segment Z^2 over the cube, segments sharded
+    across devices.
+
+    ``seg_times``/``seg_weights`` are (S, Nmax) zero-weight-padded segment
+    rows (S a multiple of the segment mesh size — callers pad with all-zero
+    rows, which contribute exactly 0 to the stack). Each device runs the same
+    exact per-segment 3-D kernel as the single-device loop; only the
+    cross-segment summation order differs (shard-local sum, then psum), so
+    parity with the loop path is reduction-order tolerance, not bitwise.
+    Returns the (n_fddot, n_fdot, n_freq) stacked power as a jax array.
+    """
+    if mesh is None:
+        mesh = segment_mesh()
+    fd = jnp.asarray(np.atleast_1d(np.asarray(fdots, dtype=np.float64)))
+    fdd = jnp.asarray(np.atleast_1d(np.asarray(fddots, dtype=np.float64)))
+
+    def kernel(t_sh, w_sh, fd_all, fdd_all):
+        def one_segment(rows):
+            t_row, w_row = rows
+            c, s = harmonic_sums_uniform_3d(
+                t_row, f0, df, n_freq, fd_all, fdd_all, nharm,
+                event_block, trial_block, weights=w_row, poly=poly,
+            )
+            # 0/1 weight totals are exact integers in f64: any summation
+            # order yields identical bits, and empty pad rows normalize by 1
+            n_seg = jnp.maximum(jnp.sum(w_row), 1.0)  # graftlint: disable=GL005 (exact integer-valued total of the 0/1 weight mask; order-insensitive at the bit level)
+            power = z2_from_sums(c, s, n_seg)
+            return jnp.sum(power, axis=2)  # graftlint: disable=GL005 (sums the replicated nharm axis inside one segment, not the sharded segment axis)
+        terms = jax.lax.map(one_segment, (t_sh, w_sh))
+        local = jnp.sum(terms, axis=0)  # graftlint: disable=GL005 (shard-local partial of the segment stack; the cross-segment order is pinned only to reduction-order tolerance by contract)
+        return jax.lax.psum(local, SEGMENT_AXIS)
+
+    plan = specs_for("semicoherent_stack", mesh)
+    args = (jnp.asarray(np.asarray(seg_times, dtype=np.float64)),
+            jnp.asarray(np.asarray(seg_weights, dtype=np.float64)), fd, fdd)
+    sharded = shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=plan.in_specs("seg_times", "seg_weights", "fdots", "fddots"),
+        out_specs=plan.out_specs,
+    )
+    out = sharded(*args)
+    costmodel.capture("semicoherent_stack", sharded, *args,
+                      plan=specs_for("semicoherent_stack", mesh))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -93,6 +93,30 @@ RULES: tuple[KernelRule, ...] = (
              "axis_index, so only events/weights are array inputs",
     ),
     KernelRule(
+        kernel=r"^sharded_sums_grid3d$",
+        params=(
+            (r"^(times|weights)$", P(EVENT_AXIS)),
+            (r"^(fdots|fddots)$", P(None)),
+        ),
+        outs=(P(None, None, None, TRIAL_AXIS), P(None, None, None, TRIAL_AXIS)),
+        reduce_axes=(EVENT_AXIS,),
+        note="uniform-grid (f, fdot, fddot) cube: frequency range derived "
+             "from axis_index, fdot/fddot axes replicated, events "
+             "psum-reduced exactly like the 2-D grid kernel",
+    ),
+    KernelRule(
+        kernel=r"^semicoherent_stack$",
+        params=(
+            (r"^seg_(times|weights)$", P(SEGMENT_AXIS)),
+            (r"^(fdots|fddots)$", P(None)),
+        ),
+        outs=(P(None, None, None),),
+        reduce_axes=(SEGMENT_AXIS,),
+        note="semi-coherent cube stack: zero-weight-padded segment rows are "
+             "data parallel over the segment axis; the incoherent sum of "
+             "per-segment Z^2 terms is the one psum",
+    ),
+    KernelRule(
         kernel=r"^delta_refold",
         params=(
             (r"^(folded|delta|anchor_idx)$", P(EVENT_AXIS)),

@@ -74,6 +74,28 @@ def candidate_rate(kernel: str, sec, freqs, f0, df, n_trials: int,
         fn = lambda: search.harmonic_sums_uniform_mxu(  # noqa: E731
             times, float(f0), float(df), int(n_trials), nharm,
             event_block=event_block, trial_block=trial_block, poly=poly)[0]
+    elif kernel == "grid3d":
+        # small (fdot, fddot) cross axes around the A/B target: the cube
+        # kernel's rate is quoted in CUBE trials/s so candidates at
+        # different cross-axis sizes stay comparable
+        fdots = jnp.asarray([-9.2e-14, -9.3e-14, -9.4e-14, -9.5e-14])
+        fddots = jnp.asarray([-1e-20, 1e-20])
+        n_freq = max(int(trial_block), int(n_trials) // 8)
+        fn = lambda: search.harmonic_sums_uniform_3d(  # noqa: E731
+            times, float(f0), float(df), n_freq, fdots, fddots, nharm,
+            event_block=event_block, trial_block=trial_block, poly=poly)[0]
+        return best_rate(fn, n_freq * 4 * 2, repeats=repeats)
+    elif kernel == "semicoherent":
+        from crimp_tpu.ops import semicoherent as semi
+
+        fdots = np.asarray([-9.2e-14, -9.3e-14, -9.4e-14, -9.5e-14])
+        fddots = np.asarray([-1e-20, 1e-20])
+        n_freq = max(int(trial_block), int(n_trials) // 8)
+        fn = lambda: semi.semicoherent_z2_grid(  # noqa: E731
+            np.asarray(sec), float(f0), float(df), n_freq, fdots, fddots,
+            nharm=nharm, n_segments=4, poly=poly,
+            event_block=event_block, trial_block=trial_block, mxu=False)
+        return best_rate(fn, n_freq * 4 * 2, repeats=repeats)
     elif kernel == "general":
         freqs_dev = jnp.asarray(freqs)
         fn = lambda: search.harmonic_sums_1d(  # noqa: E731

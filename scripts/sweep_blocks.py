@@ -12,8 +12,13 @@ the one-JSON-line-per-point output contract, and the Pallas tile sweep
 state, so that section stays inline).
 
 Usage: python scripts/sweep_blocks.py [--events 800000] [--trials 100000]
-       [--kernel grid|grid_mxu|general|multisource] [--no-poly] [--no-persist]
+       [--kernel grid|grid_mxu|grid3d|semicoherent|general|multisource]
+       [--no-poly] [--no-persist]
        [--pallas]  (also sweep the Pallas kernel's trial_tile/event_chunk)
+
+The ``--kernel`` choices come from ``autotune.BLOCK_KERNELS`` — the same
+registry ``resolve_blocks`` validates against — so a kernel added to the
+autotuner can never silently miss the sweep.
 
 ``--kernel multisource`` sweeps the survey batch engine's
 (event_block=padded per-source width, trial_block=source rows per
@@ -44,11 +49,13 @@ def log(msg: str) -> None:
 
 
 def main():
+    from crimp_tpu.ops import autotune
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--events", type=int, default=800_000)
     ap.add_argument("--trials", type=int, default=100_000)
     ap.add_argument("--kernel",
-                    choices=("grid", "grid_mxu", "general", "multisource"),
+                    choices=autotune.BLOCK_KERNELS,
                     default="grid")
     ap.add_argument("--no-poly", action="store_true",
                     help="sweep the hardware-trig path instead of poly trig")

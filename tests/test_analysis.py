@@ -337,6 +337,26 @@ class TestGL003:
                        tools_md_text=FAKE_DOCS, numeric_keys=("fake_mode",))
         assert rep.unwaived == []
 
+    def test_unregistered_grid3d_read_fires(self, tmp_path):
+        """The 3-D cube path deliberately adds NO env knob of its own — it
+        shares CRIMP_TPU_GRID_MXU and CRIMP_TPU_GRID_BLOCKS. A hypothetical
+        CRIMP_TPU_GRID3D read is therefore an UNREGISTERED knob and must
+        turn the gate red instead of slipping in undeclared."""
+        assert "CRIMP_TPU_GRID3D" not in knobs.REGISTRY  # the real registry
+        rep = run_tree(tmp_path, {"pkg/mod.py": """
+            import os
+
+            X = os.environ.get("CRIMP_TPU_GRID3D", "")
+        """}, rules=("GL003",), registry=dict(knobs.REGISTRY),
+            tools_md_text="\n".join(
+                f"| `{k}` | x | x |" for k in knobs.REGISTRY),
+            numeric_keys=tuple(
+                k.numeric_key for k in knobs.REGISTRY.values()
+                if k.numeric_key))
+        msgs = [f.message for f in rep.unwaived]
+        assert any("CRIMP_TPU_GRID3D" in m and "unregistered" in m
+                   for m in msgs)
+
 
 class TestGL003AgainstRepo:
     """The removal tests the issue pins: deleting a knob's docs row or its

@@ -471,6 +471,110 @@ class TestShardedGridMXU:
         assert int(np.argmax(fact)) == int(np.argmax(exact))
 
 
+class TestSharded3D:
+    """The (f, fdot, fddot) cube under sharding, and the segment-sharded
+    semi-coherent stack."""
+
+    N_FREQ = 8 * 64 * 2  # 2 trial tiles per shard at trial_block=64
+
+    @pytest.fixture()
+    def pinned_blocks(self, monkeypatch):
+        monkeypatch.setenv("CRIMP_TPU_GRID_BLOCKS", "512,64")
+        monkeypatch.delenv("CRIMP_TPU_GRID_MXU", raising=False)
+
+    @pytest.fixture()
+    def cube_axes(self):
+        return np.array([-1e-13, 0.0]), np.array([-1e-18, 1e-18])
+
+    def test_3d_matches_single_device(self, events, freqs, cube_axes,
+                                      pinned_blocks):
+        fdots, fddots = cube_axes
+        f0, df = search.uniform_grid(freqs)
+        expected = np.asarray(search.z2_power_3d_grid(
+            jnp.asarray(events), f0, df, len(freqs), jnp.asarray(fdots),
+            jnp.asarray(fddots), 2, event_block=512, trial_block=64,
+            mxu=False))
+        for ev_par in (2, 8):
+            mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=ev_par)
+            got = pmesh.z2_3d_sharded(events, freqs, fdots, fddots, nharm=2,
+                                      mesh=mesh, use_mxu=False)
+            assert got.shape == (2, 2, len(freqs))
+            np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-3)
+
+    def test_3d_sharded_mxu_matches_monolithic(self, events, cube_axes,
+                                               pinned_blocks):
+        """Trial-axis-only mesh: the tile0 offset hands every shard the
+        monolithic f_tiles, and the per-shard kernel call reproduces the
+        monolithic columns bit for bit (pinned at the kernel level by
+        TestGrid3D). End to end on VIRTUAL CPU devices the pin is only
+        near-bitwise: the cube matmul's small M*N puts XLA CPU's f32
+        dot_general into a thread-count-dependent K-split whose reduction
+        order shifts under an 8-partition compile — a CPU-emitter artifact
+        the 2-D kernel's larger rows don't hit, not a sharding leak, so
+        this asserts at f32-reduction tolerance with an identical argmax."""
+        fdots, fddots = cube_axes
+        freqs = np.linspace(0.14315, 0.14315 + 1e-6 * (self.N_FREQ - 1),
+                            self.N_FREQ)
+        f0, df = search.uniform_grid(freqs)
+        mono = np.asarray(search.z2_power_3d_grid(
+            jnp.asarray(events), f0, df, self.N_FREQ, jnp.asarray(fdots),
+            jnp.asarray(fddots), nharm=2, event_block=512, trial_block=64,
+            mxu=True, reseed=64, mxu_bf16=False))
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=1)
+        got = np.asarray(pmesh.z2_3d_sharded(
+            events, freqs, fdots, fddots, nharm=2, mesh=mesh, use_mxu=True,
+            reseed=64, mxu_bf16=False))
+        assert got.shape == mono.shape == (2, 2, self.N_FREQ)
+        np.testing.assert_allclose(got, mono, rtol=1e-3, atol=0.01)
+        assert int(np.argmax(got)) == int(np.argmax(mono))
+
+    def test_3d_fddot_zero_bitmatches_2d_sharded(self, events, freqs,
+                                                 cube_axes, pinned_blocks):
+        """The sharded cube at fddots=[0.0] reduces to the sharded 2-D scan
+        bit for bit (the kernel-level zero-row contract survives the psum,
+        which sums the same f64 values in the same order)."""
+        fdots, _ = cube_axes
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=4)
+        two_d = np.asarray(pmesh.z2_2d_sharded(
+            events, freqs, fdots, nharm=2, mesh=mesh, use_mxu=False))
+        cube = pmesh.z2_3d_sharded(events, freqs, fdots, np.array([0.0]),
+                                   nharm=2, mesh=mesh, use_mxu=False)
+        np.testing.assert_array_equal(cube[0], two_d)
+
+    def test_3d_nonuniform_falls_back(self, events, cube_axes):
+        """A non-uniform frequency list routes to the single-device general
+        cube kernel."""
+        fdots, fddots = cube_axes
+        freqs = np.concatenate([np.linspace(0.1430, 0.1431, 16),
+                                np.linspace(0.1434, 0.1438, 17)])
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=4)
+        got = pmesh.z2_3d_sharded(events, freqs, fdots, fddots, nharm=2,
+                                  mesh=mesh)
+        assert got.shape == (2, 2, 33)
+        expected = np.asarray(search.z2_power_3d(
+            jnp.asarray(events), jnp.asarray(freqs), jnp.asarray(fdots),
+            jnp.asarray(fddots), 2))
+        np.testing.assert_allclose(got, expected, rtol=1e-12, atol=1e-9)
+
+    def test_semicoherent_stack_sharded_matches_loop(self, events,
+                                                     pinned_blocks):
+        """Segment-sharded stack == the single-device ascending loop to
+        reduction-order tolerance (shard-local sums + psum regroup the
+        cross-segment addition; per-segment terms are identical)."""
+        from crimp_tpu.ops import semicoherent as semi
+
+        fdots = np.array([-1e-13, 0.0])
+        fddots = np.array([-1e-18, 1e-18])
+        t = events - events.min()
+        kw = dict(f0=0.14315, df=1e-6, n_freq=128, fdots=fdots,
+                  fddots=fddots, nharm=2, n_segments=6)
+        loop = np.asarray(semi.semicoherent_z2_grid(t, **kw))
+        mesh = pmesh.segment_mesh(jax.devices()[:8])
+        sharded = np.asarray(semi.semicoherent_z2_grid(t, mesh=mesh, **kw))
+        assert sharded.shape == loop.shape == (2, 2, 128)
+        np.testing.assert_allclose(sharded, loop, rtol=1e-12, atol=1e-9)
+
+
 class TestShardedMultisource:
     """Source-axis data parallelism of the survey batch engine: the
     stacked fold shards whole source rows across the 8 virtual devices

@@ -425,3 +425,122 @@ class TestResumableDeltaFold:
         with pytest.raises(ValueError, match="fingerprint mismatch"):
             ResumableScan(events, freqs, nharm=2, store=str(store),
                           chunk_trials=200)
+
+
+class TestResumable3D:
+    """The (f, fdot, fddot) cube and the semi-coherent stack through the
+    checkpointed scan: round-trips, store pinning, and the semicoherent
+    fingerprint key."""
+
+    FDOTS = np.array([-1e-10, 0.0])
+    FDDOTS = np.array([-1e-15, 1e-15])
+
+    def test_chunked_matches_unchunked_3d(self, events):
+        freqs = np.linspace(0.1428, 0.1436, 500)
+        expected = np.asarray(search.z2_power_3d(
+            jax.numpy.asarray(events), jax.numpy.asarray(freqs),
+            jax.numpy.asarray(self.FDOTS), jax.numpy.asarray(self.FDDOTS), 2))
+        got = ResumableScan(events, freqs, nharm=2, fdots=self.FDOTS,
+                            fddots=self.FDDOTS, chunk_trials=200).run()
+        assert got.shape == (2, 2, 500)
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-3)
+
+    def test_3d_store_roundtrip_resumes_only_missing(self, events, tmp_path):
+        """Drop a chunk of a finished 3-D store; the resume recomputes only
+        that chunk and reassembles the identical cube."""
+        freqs = np.linspace(0.1428, 0.1436, 600)
+        store = tmp_path / "ckpt"
+        kw = dict(nharm=2, fdots=self.FDOTS, fddots=self.FDDOTS,
+                  store=str(store), chunk_trials=200)
+        full = ResumableScan(events, freqs, **kw).run()
+        assert full.shape == (2, 2, 600)
+        (store / "chunk_00001.npy").unlink()
+        recomputed = []
+        scan2 = ResumableScan(events, freqs, **kw)
+        assert scan2.done_chunks() == [0, 2]
+        resumed = scan2.run(progress=lambda i, n: recomputed.append(i))
+        assert recomputed == [1]
+        np.testing.assert_array_equal(resumed, full)
+
+    def test_3d_fingerprint_covers_fddots(self, events, tmp_path):
+        """A cube store can never be resumed for a different fddot grid —
+        and a 2-D store never mistaken for a 3-D one."""
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        ResumableScan(events, freqs, nharm=2, fdots=self.FDOTS,
+                      fddots=self.FDDOTS, store=str(store),
+                      chunk_trials=200).run()
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, fdots=self.FDOTS,
+                          fddots=self.FDDOTS * 2.0, store=str(store),
+                          chunk_trials=200)
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, fdots=self.FDOTS,
+                          store=str(store), chunk_trials=200)
+
+    def test_3d_mxu_conflict_refusal(self, events, tmp_path, monkeypatch):
+        """The cube path pins the factorized-kernel choice in the SAME
+        numeric_mode["grid_mxu"] entry as the 2-D path: a store written
+        with the 3-D MXU kernel refuses an explicit =0 resume."""
+        import json
+
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        store = tmp_path / "ckpt"
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "1")
+        scan = ResumableScan(events, freqs, nharm=2, fdots=self.FDOTS,
+                             fddots=self.FDDOTS, store=str(store),
+                             chunk_trials=200)
+        assert scan._mxu
+        got = scan.run()
+        fp = json.loads((store / "manifest.json").read_text())
+        assert fp["numeric_mode"]["grid_mxu"][0] == 1
+        assert fp["fddots"] == [float(v) for v in self.FDDOTS]
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "0")
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, fdots=self.FDOTS,
+                          fddots=self.FDDOTS, store=str(store),
+                          chunk_trials=200)
+        # and the factorized cube stays inside the documented budget
+        exact = ResumableScan(events, freqs, nharm=2, fdots=self.FDOTS,
+                              fddots=self.FDDOTS, chunk_trials=200,
+                              ).run()
+        assert np.max(np.abs(got - exact)) < 0.01 * np.sqrt(4.0 * 2)
+
+    def test_semicoherent_roundtrip_and_fingerprint(self, events, tmp_path):
+        """A semi-coherent cube scan round-trips through the store; the
+        segment count is fingerprinted so coherent and stacked chunks can
+        never mix."""
+        from crimp_tpu.ops import semicoherent as semi
+
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        f0, df = search.uniform_grid(freqs)
+        store = tmp_path / "ckpt"
+        kw = dict(nharm=2, fdots=self.FDOTS, fddots=self.FDDOTS,
+                  semicoherent=4, store=str(store), chunk_trials=200)
+        got = ResumableScan(events, freqs, **kw).run()
+        expected = np.asarray(semi.semicoherent_z2_grid(
+            events, f0, df, len(freqs), self.FDOTS, self.FDDOTS,
+            nharm=2, n_segments=4))
+        assert got.shape == expected.shape == (2, 2, 400)
+        np.testing.assert_allclose(got, expected, rtol=1e-4, atol=1e-3)
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, fdots=self.FDOTS,
+                          fddots=self.FDDOTS, semicoherent=8,
+                          store=str(store), chunk_trials=200)
+        with pytest.raises(ValueError, match="fingerprint mismatch"):
+            ResumableScan(events, freqs, nharm=2, fdots=self.FDOTS,
+                          fddots=self.FDDOTS, store=str(store),
+                          chunk_trials=200)
+
+    def test_semicoherent_validation(self, events):
+        freqs = np.linspace(0.1428, 0.1436, 400)
+        with pytest.raises(ValueError, match="fddots"):
+            ResumableScan(events, freqs, nharm=2, fdots=self.FDOTS,
+                          semicoherent=4)
+        nonuniform = np.concatenate([freqs[:100], freqs[150:]])
+        with pytest.raises(ValueError, match="uniform"):
+            ResumableScan(events, nonuniform, nharm=2, fdots=self.FDOTS,
+                          fddots=self.FDDOTS, semicoherent=4)
+        with pytest.raises(ValueError, match="fdots|fddots"):
+            ResumableScan(events, freqs, nharm=10, statistic="h",
+                          fddots=self.FDDOTS)

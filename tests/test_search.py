@@ -310,9 +310,14 @@ class TestPolyTrig:
         poly_g = search.PeriodSearch(sim_events, jagged, 2, poly_trig=True).ztest()
         np.testing.assert_allclose(poly_g, hw_g, rtol=1e-4, atol=1e-2)
 
+    @pytest.mark.slow
     def test_htest_poly_high_nharm(self, sim_events, monkeypatch):
         """Chebyshev recurrence on poly-trig values stays accurate at the
-        default H-test order."""
+        default H-test order.
+
+        Slow tier: the nharm-20 rung costs ~40 s on the 1-core CI host and
+        tier-1 runs against a hard wall-clock budget; the poly-trig path
+        itself stays tier-1-covered by test_z2_poly_matches_hardware_trig."""
         monkeypatch.setenv("CRIMP_TPU_SHARD", "0")
         freqs = np.linspace(0.2495, 0.2505, 64)
         hw = search.PeriodSearch(sim_events, freqs, 20, poly_trig=False).htest()
@@ -445,12 +450,19 @@ class TestGridFastpathOptOut:
         monkeypatch.setenv("CRIMP_TPU_GRID_FASTPATH", "1")
         assert search.grid_fastpath_enabled(20)
 
+    @pytest.mark.slow
     def test_high_nharm_htest_fastpath_accuracy(self, sim_events, monkeypatch):
         """Default H-test order (20) now takes the f64-lean fast path (the
         measured Chebyshev-amplified error is ~1e-4 of the statistic's
         noise; see GRID_FASTPATH_MAX_NHARM), and must agree with the
         exact-f64-phase kernel. Past the cap, auto mode still declines.
-        Single-device pinned: auto-sharding would change accumulation order."""
+        Single-device pinned: auto-sharding would change accumulation order.
+
+        Slow tier: the two exact-f64 nharm-20/21 scans cost ~80 s on the
+        1-core CI host against tier-1's hard wall-clock budget; the fast
+        path keeps tier-1 accuracy coverage at nharm=8 via
+        TestUniformGridFastPath::test_h_grid_matches and the cap/override
+        plumbing via the env/auto tests above."""
         import jax.numpy as jnp
 
         monkeypatch.setenv("CRIMP_TPU_SHARD", "0")
@@ -601,7 +613,25 @@ class TestGridMXU:
             assert np.max(np.abs(fact - exact)) < self.budget(3)
             assert int(np.argmax(fact)) == int(np.argmax(exact))
 
+    def test_h_parity_low_nharm(self, sim_events):
+        """Cheap tier-1 twin of the nharm-20 rung below: H-statistic MXU
+        parity (max-over-cumsum on factorized sums) at nharm=5."""
+        sec = sim_events[::4] - sim_events[::4].mean()
+        freqs = np.linspace(0.2495, 0.2505, 128)
+        f0, df = freqs[0], float(freqs[1] - freqs[0])
+        exact = np.asarray(search.h_power_grid(
+            sec, f0, df, len(freqs), 5, mxu=False))
+        fact = np.asarray(search.h_power_grid(
+            sec, f0, df, len(freqs), 5, mxu=True, reseed=64,
+            mxu_bf16=False))
+        assert np.max(np.abs(fact - exact)) < self.budget(5)
+        assert int(np.argmax(fact)) == int(np.argmax(exact))
+
+    @pytest.mark.slow
     def test_h_parity_high_nharm(self, sim_events):
+        # Slow tier: the exact nharm-20 H scan over 256 trials costs ~65 s
+        # on the 1-core CI host against tier-1's hard wall-clock budget;
+        # tier-1 keeps H+MXU parity via test_h_parity_low_nharm above.
         sec = sim_events - sim_events.mean()
         freqs = np.linspace(0.2495, 0.2505, 256)
         f0, df = freqs[0], float(freqs[1] - freqs[0])
@@ -723,6 +753,181 @@ class TestGridMXU:
         assert int(np.argmax(b16)) == int(np.argmax(f32))
         # bf16 has ~3 decimal digits: deviation scales with the peak power
         assert np.max(np.abs(b16 - f32)) < 0.02 * np.max(f32)
+
+
+class TestGrid3D:
+    """The (f, fdot, fddot) jerk cube: exact scan kernel, factorized MXU
+    twin, streamed twins, and the PeriodSearch wrapper.
+
+    Contracts (docs/parity.md): the exact 3-D kernel with ``fddots=[0.0]``
+    is BITWISE-identical to the 2-D kernel (the cubic row contributes an
+    exact f64 zero); the factorized twin carries the same 1%-of-noise
+    deviation budget and identical-argmax gate as the 2-D MXU kernels.
+    """
+
+    BUDGET_FRAC = 0.01
+
+    def budget(self, nharm):
+        return self.BUDGET_FRAC * np.sqrt(4.0 * nharm)
+
+    @pytest.fixture()
+    def cube(self, sim_events):
+        # 4x event subsample: keeps the +-1e4 s span (what the decoherence
+        # spacings below are tuned to) while the exact cube scans stay cheap
+        sec = sim_events[::4] - sim_events[::4].mean()
+        freqs = np.linspace(0.2495, 0.2505, 97)  # ragged at trial_block=64
+        # spacings chosen so off-center rows DECOHERE the injected signal
+        # (several cycles of drift over the +-1e4 s span): the cube then has
+        # one unique peak cell and the argmax gates are meaningful instead
+        # of flipping between nine numerically degenerate copies
+        fdots = np.array([-2e-7, 0.0, 2e-7])
+        fddots = np.array([-3e-11, 0.0, 3e-11])
+        return sec, freqs, fdots, fddots
+
+    def test_exact_grid_matches_general_cube(self, cube):
+        import jax.numpy as jnp
+
+        sec, freqs, fdots, fddots = cube
+        f0, df = freqs[0], float(freqs[1] - freqs[0])
+        grid = np.asarray(search.z2_power_3d_grid(
+            sec, f0, df, len(freqs), fdots, fddots, 2, mxu=False))
+        gen = np.asarray(search.z2_power_3d(
+            jnp.asarray(sec), jnp.asarray(freqs), jnp.asarray(fdots),
+            jnp.asarray(fddots), 2))
+        assert grid.shape == (3, 3, 97)
+        np.testing.assert_allclose(grid, gen, rtol=1e-4, atol=1e-3)
+
+    def test_fddot_zero_bitmatches_2d_kernel(self, cube):
+        """Adding an exact-zero cubic row must not move one bit: the 3-D
+        kernel at fddots=[0.0] IS the 2-D kernel."""
+        sec, freqs, fdots, _ = cube
+        f0, df = freqs[0], float(freqs[1] - freqs[0])
+        c2, s2 = search.harmonic_sums_uniform_2d(
+            sec, f0, df, len(freqs), fdots, 3,
+            event_block=1024, trial_block=64)
+        c3, s3 = search.harmonic_sums_uniform_3d(
+            sec, f0, df, len(freqs), fdots, np.array([0.0]), 3,
+            event_block=1024, trial_block=64)
+        np.testing.assert_array_equal(np.asarray(c3[0]), np.asarray(c2))
+        np.testing.assert_array_equal(np.asarray(s3[0]), np.asarray(s2))
+
+    def test_mxu_parity_poly_on_off(self, cube):
+        sec, freqs, fdots, fddots = cube
+        f0, df = freqs[0], float(freqs[1] - freqs[0])
+        for poly in (False, True):
+            exact = np.asarray(search.z2_power_3d_grid(
+                sec, f0, df, len(freqs), fdots, fddots, 3, poly=poly,
+                mxu=False))
+            fact = np.asarray(search.z2_power_3d_grid(
+                sec, f0, df, len(freqs), fdots, fddots, 3, poly=poly,
+                mxu=True, reseed=64, mxu_bf16=False))
+            assert np.max(np.abs(fact - exact)) < self.budget(3)
+            assert int(np.argmax(fact)) == int(np.argmax(exact))
+
+    def test_mxu_weighted_parity(self, cube):
+        sec, freqs, fdots, fddots = cube
+        rng = np.random.RandomState(29)
+        w = rng.uniform(0.5, 1.5, sec.shape[0])
+        f0, df = freqs[0], float(freqs[1] - freqs[0])
+        c_e, s_e = search.harmonic_sums_uniform_3d(
+            sec, f0, df, len(freqs), fdots, fddots, 2,
+            event_block=1024, trial_block=64, weights=w)
+        c_f, s_f = search.harmonic_sums_uniform_3d_mxu(
+            sec, f0, df, len(freqs), fdots, fddots, 2,
+            event_block=1024, trial_block=64, weights=w,
+            reseed=64, mxu_bf16=False)
+        n = sec.shape[0]
+        z_e = np.asarray(np.sum(np.asarray(
+            search.z2_from_sums(c_e, s_e, n)), axis=2))
+        z_f = np.asarray(np.sum(np.asarray(
+            search.z2_from_sums(c_f, s_f, n)), axis=2))
+        assert np.max(np.abs(z_f - z_e)) < self.budget(2)
+        assert int(np.argmax(z_f)) == int(np.argmax(z_e))
+
+    def test_streamed_bitmatches_monolithic(self):
+        rng = np.random.RandomState(17)
+        odd_times = np.sort(rng.uniform(0.0, 350.0, 5000 + 123))
+        fdots = np.linspace(-1e-9, 1e-9, 2)
+        fddots = np.linspace(-1e-13, 1e-13, 2)
+        for mxu in (False, True):
+            mono = np.asarray(search.z2_power_3d_grid(
+                odd_times, 0.2, 1e-5, 200, fdots, fddots, nharm=2,
+                event_block=512, trial_block=64, mxu=mxu,
+                reseed=64, mxu_bf16=False))
+            strm = np.asarray(search.z2_power_3d_grid_streamed(
+                odd_times, 0.2, 1e-5, 200, fdots, fddots, nharm=2,
+                event_block=512, trial_block=64, event_chunk=1024,
+                mxu=mxu, reseed=64, mxu_bf16=False))
+            np.testing.assert_array_equal(strm, mono)
+
+    def test_mxu_bf16_composes(self, cube):
+        sec, freqs, fdots, fddots = cube
+        f0, df = freqs[0], float(freqs[1] - freqs[0])
+        f32 = np.asarray(search.z2_power_3d_grid(
+            sec, f0, df, len(freqs), fdots, fddots, 2, mxu=True,
+            reseed=64, mxu_bf16=False))
+        b16 = np.asarray(search.z2_power_3d_grid(
+            sec, f0, df, len(freqs), fdots, fddots, 2, mxu=True,
+            reseed=64, mxu_bf16=True))
+        assert int(np.argmax(b16)) == int(np.argmax(f32))
+        assert np.max(np.abs(b16 - f32)) < 0.02 * np.max(f32)
+
+    def test_h_power_3d_grid_reduces_to_h_grid(self, cube):
+        """One (fdot, fddot) cell of the H cube matches the 1-D H fast path
+        at the same trial family (fdot=fddot=0). The 1-D kernel builds its
+        phase without the 2-D/3-D row additions, so this pair agrees to
+        f32 trig tolerance — the BITWISE zero-row contract is the
+        2-D <-> 3-D pair (test_fddot_zero_bitmatches_2d_kernel)."""
+        sec, freqs, _, _ = cube
+        f0, df = freqs[0], float(freqs[1] - freqs[0])
+        cube_h = np.asarray(search.h_power_3d_grid(
+            sec, f0, df, len(freqs), np.array([0.0]), np.array([0.0]),
+            nharm=5, event_block=4096, trial_block=64, mxu=False))
+        line_h = np.asarray(search.h_power_grid(
+            sec, f0, df, len(freqs), 5, event_block=4096, trial_block=64,
+            mxu=False))
+        np.testing.assert_allclose(cube_h[0, 0], line_h,
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_periodsearch_threed_ztest_rows(self, sim_events):
+        """Row ordering contract: outer fddot, then fdot, then freq; the
+        fdot axis keeps the reference log10 spin-down convention and the
+        fddot axis is signed."""
+        freqs = np.linspace(0.2495, 0.2505, 65)
+        ps = search.PeriodSearch(sim_events[::4], freqs, nbrHarm=2)
+        log_fdots = np.array([-12.0, -11.0])
+        fdd = np.array([-1e-16, 1e-16])
+        rows, df = ps.threed_ztest(log_fdots, fdd)
+        assert list(df.columns) == ["Freq", "Freq_dot", "Freq_ddot", "Z2pow"]
+        assert rows.shape == (65 * 2 * 2, 4)
+        # outer fddot: first half all at fdd[0]; inner fdot repeats per fddot
+        assert np.all(rows[: 65 * 2, 2] == fdd[0])
+        assert np.all(rows[65 * 2:, 2] == fdd[1])
+        assert np.all(rows[:65, 1] == log_fdots[0])
+        assert np.all(rows[65: 65 * 2, 1] == log_fdots[1])
+        np.testing.assert_array_equal(rows[:65, 0], freqs)
+        # the injected 0.25 Hz signal survives the cube scan
+        peak = rows[np.argmax(rows[:, 3])]
+        assert peak[0] == pytest.approx(0.25, abs=5e-5)
+
+    def test_threed_ztest_fddot_zero_matches_twod(self, sim_events,
+                                                  monkeypatch):
+        """A cube with one zero fddot row reproduces twod_ztest's power
+        column exactly (same kernels, one added exact-zero row).
+
+        Pinned to the single-device grid path with one block shape and the
+        MXU off so the 2-D and 3-D scans dispatch the bitwise-contracted
+        kernel pair (the "grid" and "grid3d" autotune keys may otherwise
+        resolve different cached winners)."""
+        monkeypatch.setattr(search, "MIN_SHARD_PAIRS", 1 << 62)
+        monkeypatch.setenv("CRIMP_TPU_GRID_BLOCKS", "16384,512")
+        monkeypatch.setenv("CRIMP_TPU_GRID_MXU", "0")
+        freqs = np.linspace(0.2495, 0.2505, 65)
+        ps = search.PeriodSearch(sim_events[::4], freqs, nbrHarm=2)
+        log_fdots = np.array([-12.0, -11.0])
+        rows2, _ = ps.twod_ztest(log_fdots)
+        rows3, _ = ps.threed_ztest(log_fdots, np.array([0.0]))
+        np.testing.assert_array_equal(rows3[:, 3], rows2[:, 2])
 
 
 @pytest.mark.slow

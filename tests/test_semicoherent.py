@@ -1,0 +1,265 @@
+"""Semi-coherent stacking (ops/semicoherent): segmentation, the stacking
+parity contracts, and the model-folded stack glue.
+
+The two load-bearing numeric pins (docs/parity.md "Semi-coherent stack"):
+
+- ``stack="coherent"`` re-blocks the event reduction, so it must match the
+  monolithic coherent cube kernel to reduction-order tolerance — this is
+  the bridge that ties the stacked statistic to the coherent one;
+- ``stack="incoherent"`` sums per-segment Z^2 in fixed ascending segment
+  order and must BITWISE-match a hand-written per-segment loop over the
+  same padded rows.
+"""
+
+import numpy as np
+import pytest
+
+from crimp_tpu.ops import search
+from crimp_tpu.ops import semicoherent as semi
+
+
+@pytest.fixture(scope="module")
+def pulsed_events():
+    """A steady pulsed source: constant frequency, no derivatives."""
+    from crimp_tpu.pipelines.simulate import simulate_modulated_lc
+
+    rng = np.random.RandomState(11)
+    # srcrate halved vs the search-suite sim: the stacking contracts are
+    # self-consistent (bitwise / reduction-order), so event count only buys
+    # peak S/N — which pulsedfraction=0.4 over 16 ks has to spare — while
+    # the 16 ks span is what the CUBE decoherence spacings are tuned to
+    sim = simulate_modulated_lc(freq=0.25, srcrate=1.5, exposure=16000,
+                                pulsedfraction=0.4, bgrrate=0.1, rng=rng)
+    t = np.asarray(sim["assigned_t_wBgr"], dtype=np.float64)
+    return t - t[0]
+
+
+CUBE = dict(f0=0.2496, df=1e-5, n_freq=97,
+            fdots=np.array([-2e-8, 0.0, 2e-8]),
+            fddots=np.array([-5e-12, 0.0, 5e-12]))
+
+
+class TestSplitSegments:
+    def test_partition_roundtrip(self, pulsed_events):
+        seg_t, seg_w = semi.split_segments(pulsed_events, 5)
+        assert seg_t.shape == seg_w.shape
+        assert seg_t.shape[0] == 5
+        assert seg_w.sum() == pulsed_events.size
+        recovered = np.sort(seg_t[seg_w > 0.0])
+        np.testing.assert_array_equal(recovered, np.sort(pulsed_events))
+
+    def test_equal_duration_edges(self):
+        # events clustered at the start: equal DURATION, not equal count
+        t = np.concatenate([np.linspace(0.0, 10.0, 90),
+                            np.linspace(90.0, 100.0, 10)])
+        seg_t, seg_w = semi.split_segments(t, 4)
+        counts = seg_w.sum(axis=1)
+        assert counts[0] == 90  # all clustered events in the first quarter
+        assert counts[1] == counts[2] == 0
+        assert counts[3] == 10
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="n_segments"):
+            semi.split_segments(np.arange(5.0), 0)
+        with pytest.raises(ValueError, match="non-empty"):
+            semi.split_segments(np.empty(0), 2)
+        with pytest.raises(ValueError, match="non-empty"):
+            semi.split_segments(np.zeros((3, 3)), 2)
+        with pytest.raises(ValueError, match="sorted"):
+            semi.split_segments(np.array([3.0, 1.0, 2.0]), 2)
+
+
+class TestStackParity:
+    def test_coherent_stack_matches_monolithic(self, pulsed_events):
+        """Summing per-segment trig sums == the monolithic coherent kernel
+        (same events, re-blocked reduction) to reduction-order tolerance."""
+        # n_segments=4 everywhere in this class (except the S=1 collapse
+        # test): one padded row width -> one compile of the per-segment
+        # kernel shared by all the stack tests
+        stacked = np.asarray(semi.semicoherent_z2_grid(
+            pulsed_events, stack="coherent", n_segments=4, nharm=2,
+            event_block=4096, trial_block=64, mxu=False, **CUBE))
+        mono = np.asarray(search.z2_power_3d_grid(
+            pulsed_events, CUBE["f0"], CUBE["df"], CUBE["n_freq"],
+            CUBE["fdots"], CUBE["fddots"], 2,
+            event_block=4096, trial_block=64, mxu=False))
+        assert stacked.shape == mono.shape == (3, 3, 97)
+        # "reduction-order tolerance": the per-block partial sums are f32,
+        # so regrouping ~50k events into segments moves the result at the
+        # f32-sum level, not the f64 level
+        np.testing.assert_allclose(stacked, mono, rtol=1e-4, atol=1e-3)
+
+    def test_incoherent_stack_bitmatches_hand_loop(self, pulsed_events):
+        """The incoherent stack is a fixed ascending-order loop — pin it
+        bitwise against an independently written per-segment loop."""
+        seg_t, seg_w = semi.split_segments(pulsed_events, 4)
+        expected = None
+        for i in range(seg_t.shape[0]):
+            import jax.numpy as jnp
+
+            c, s = search.harmonic_sums_uniform_3d(
+                seg_t[i], CUBE["f0"], CUBE["df"], CUBE["n_freq"],
+                CUBE["fdots"], CUBE["fddots"], 2,
+                event_block=4096, trial_block=64,
+                weights=jnp.asarray(seg_w[i]))
+            term = np.asarray(jnp.sum(
+                search.z2_from_sums(c, s, max(float(seg_w[i].sum()), 1.0)),
+                axis=2))
+            expected = term if expected is None else expected + term
+        stacked = np.asarray(semi.semicoherent_z2_grid(
+            pulsed_events, stack="incoherent", n_segments=4, nharm=2,
+            event_block=4096, trial_block=64, mxu=False, **CUBE))
+        np.testing.assert_array_equal(stacked, expected)
+
+    def test_single_segment_collapses_to_coherent(self, pulsed_events):
+        """With one segment there is nothing to stack: both modes equal the
+        monolithic kernel."""
+        inco = np.asarray(semi.semicoherent_z2_grid(
+            pulsed_events, stack="incoherent", n_segments=1, nharm=2,
+            event_block=4096, trial_block=64, mxu=False, **CUBE))
+        cohe = np.asarray(semi.semicoherent_z2_grid(
+            pulsed_events, stack="coherent", n_segments=1, nharm=2,
+            event_block=4096, trial_block=64, mxu=False, **CUBE))
+        np.testing.assert_array_equal(inco, cohe)
+        mono = np.asarray(search.z2_power_3d_grid(
+            pulsed_events, CUBE["f0"], CUBE["df"], CUBE["n_freq"],
+            CUBE["fdots"], CUBE["fddots"], 2,
+            event_block=4096, trial_block=64, mxu=False))
+        np.testing.assert_allclose(inco, mono, rtol=1e-12, atol=1e-9)
+
+    def test_incoherent_keeps_steady_peak(self, pulsed_events):
+        """The stacked statistic still finds the steady source at the same
+        cube cell as the coherent scan."""
+        # blocks pinned to the shapes the parity tests above already
+        # compiled — this test adds no new kernel shape
+        stacked = np.asarray(semi.semicoherent_z2_grid(
+            pulsed_events, stack="incoherent", n_segments=4, nharm=2,
+            event_block=4096, trial_block=64, mxu=False, **CUBE))
+        mono = np.asarray(search.z2_power_3d_grid(
+            pulsed_events, CUBE["f0"], CUBE["df"], CUBE["n_freq"],
+            CUBE["fdots"], CUBE["fddots"], 2,
+            event_block=4096, trial_block=64, mxu=False))
+        assert np.unravel_index(np.argmax(stacked), stacked.shape) == \
+            np.unravel_index(np.argmax(mono), mono.shape)
+
+    def test_mxu_stack_parity(self, pulsed_events):
+        """The factorized kernel composes with the stack: per-segment MXU
+        sums stay inside the grid-MXU deviation budget after stacking."""
+        exact = np.asarray(semi.semicoherent_z2_grid(
+            pulsed_events, stack="incoherent", n_segments=4, nharm=2,
+            event_block=4096, trial_block=64, mxu=False, **CUBE))
+        fact = np.asarray(semi.semicoherent_z2_grid(
+            pulsed_events, stack="incoherent", n_segments=4, nharm=2,
+            event_block=4096, trial_block=64, mxu=True, reseed=64,
+            mxu_bf16=False, **CUBE))
+        # 4 segments of independent ~1%-of-noise deviations
+        assert np.max(np.abs(fact - exact)) < 4 * 0.01 * np.sqrt(4.0 * 2)
+        assert int(np.argmax(fact)) == int(np.argmax(exact))
+
+    def test_unknown_stack_mode_raises(self, pulsed_events):
+        with pytest.raises(ValueError, match="stack"):
+            semi.semicoherent_z2_grid(pulsed_events, stack="hough",
+                                      n_segments=2, **CUBE)
+
+
+class TestStackedPowerFromPhases:
+    def test_z2_incoherent_equals_per_segment_sum(self):
+        rng = np.random.RandomState(3)
+        segs = [rng.uniform(0.0, 1.0, n) for n in (400, 300, 500)]
+        got = float(semi.stacked_power_from_phases(segs, nharm=2))
+        expected = 0.0
+        for ph in segs:
+            z = 0.0
+            for k in range(1, 3):
+                c = np.sum(np.cos(2 * np.pi * k * ph))
+                s = np.sum(np.sin(2 * np.pi * k * ph))
+                z += (c**2 + s**2) * 2.0 / ph.size
+            expected += z
+        assert got == pytest.approx(expected, rel=1e-5)
+
+    def test_coherent_equals_concatenated(self):
+        rng = np.random.RandomState(4)
+        segs = [rng.uniform(0.0, 1.0, n) for n in (256, 128)]
+        got = float(semi.stacked_power_from_phases(
+            segs, nharm=3, stack="coherent"))
+        whole = float(semi.stacked_power_from_phases(
+            [np.concatenate(segs)], nharm=3))
+        # f32 trig + per-call f64 accumulation: splitting the event list
+        # regroups the sum, so agreement is reduction-order level
+        assert got == pytest.approx(whole, rel=1e-6)
+
+    def test_h_statistic_on_stacked_profile(self):
+        # a coherent pulse in every segment: stacked H must beat stacked
+        # Z^2(nharm=1) only via the penalty rule, and be large
+        rng = np.random.RandomState(5)
+        segs = [np.clip(rng.normal(0.5, 0.05, 300), 0, 1) for _ in range(3)]
+        h = float(semi.stacked_power_from_phases(segs, nharm=5,
+                                                 statistic="h"))
+        z1 = float(semi.stacked_power_from_phases(segs, nharm=1))
+        assert h >= z1 - 1e-9
+        assert h > 100.0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="statistic"):
+            semi.stacked_power_from_phases([np.ones(4)], statistic="q")
+        with pytest.raises(ValueError, match="stack"):
+            semi.stacked_power_from_phases([np.ones(4)], stack="x")
+        with pytest.raises(ValueError, match="non-empty"):
+            semi.stacked_power_from_phases([np.empty(0)])
+
+
+FOLD_TM = {
+    "PEPOCH": 58359.55765869704,
+    "F0": 0.14328254547263483,
+    "F1": -9.746993965547238e-15,
+}
+
+
+class TestSegmentHFromModel:
+    def test_scores_shape_and_empty_segments(self):
+        rng = np.random.RandomState(9)
+        segs = [np.sort(58320.0 + 40.0 * i + rng.uniform(0.0, 30.0, 500))
+                for i in range(3)]
+        segs.insert(1, np.empty(0))
+        scores = semi.segment_h_from_model(FOLD_TM, segs, nharm=5)
+        assert scores.shape == (4,)
+        assert scores[1] == 0.0
+        # phases of a smooth model on random times ~ uniform: finite,
+        # modest H everywhere
+        assert np.all(np.isfinite(scores))
+
+    def test_matches_stacked_power_glue(self):
+        """Per-segment H from the batch kernel equals the scalar glue run
+        on each fold output alone."""
+        from crimp_tpu.ops import anchored
+
+        rng = np.random.RandomState(10)
+        segs = [np.sort(58320.0 + 40.0 * i + rng.uniform(0.0, 30.0, 400))
+                for i in range(2)]
+        scores = semi.segment_h_from_model(FOLD_TM, segs, nharm=5,
+                                           delta_fold=0)
+        ph, _ = anchored.fold_segments(FOLD_TM, segs, delta_fold=0)
+        for i in range(2):
+            solo = float(semi.stacked_power_from_phases(
+                [ph[i]], nharm=5, statistic="h"))
+            assert scores[i] == pytest.approx(solo, rel=1e-6)
+
+
+class TestPeriodSearchSemicoherent:
+    def test_rows_and_peak(self, pulsed_events):
+        freqs = np.linspace(0.2496, 0.2504, 65)
+        ps = search.PeriodSearch(pulsed_events, freqs, nbrHarm=2)
+        rows, df = ps.semicoherent_ztest(np.array([-12.0]),
+                                         np.array([0.0]), n_segments=4)
+        assert list(df.columns) == ["Freq", "Freq_dot", "Freq_ddot", "Z2pow"]
+        assert rows.shape == (65, 4)
+        peak = rows[np.argmax(rows[:, 3])]
+        assert peak[0] == pytest.approx(0.25, abs=5e-5)
+
+    def test_non_uniform_grid_refused(self, pulsed_events):
+        freqs = np.concatenate([np.linspace(0.24, 0.25, 32),
+                                np.linspace(0.26, 0.30, 33)])
+        ps = search.PeriodSearch(pulsed_events, freqs, nbrHarm=2)
+        with pytest.raises(ValueError, match="uniform"):
+            ps.semicoherent_ztest(np.array([-12.0]), np.array([0.0]),
+                                  n_segments=4)
