@@ -88,6 +88,22 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def process_stamp() -> dict:
+    """``{"process_index", "process_count"}`` for every bench record.
+
+    Stamped unconditionally (0/1 in single-process runs) so the ledger's
+    green baseline can refuse to mix single-host and N-host rates — a
+    4-process aggregate throughput gating a 1-process round (or vice
+    versa) would be a phantom regression/improvement."""
+    try:
+        from crimp_tpu.parallel import multihost
+
+        pidx, pcount = multihost.process_identity()
+    except Exception:  # noqa: BLE001 — records must survive a jax-free probe context  # graftlint: disable=GL006 (telemetry guard: the stamp degrades to single-process identity)
+        pidx, pcount = 0, 1
+    return {"process_index": pidx, "process_count": pcount}
+
+
 def relay_port_open(port: int, timeout_s: float = 5.0) -> bool:
     """True when the accelerator relay accepts TCP connections.
 
@@ -786,6 +802,7 @@ def jerk_main(argv=None) -> int:
         "unit": "trials/s",
         "platform": platform,
         "platform_fallback": platform == "cpu" and not platform_forced,
+        **process_stamp(),
         "trials_per_s": round(res["trials_per_s"], 1),
         "grid_shape": res["grid_shape"],
         "n_trials": res["n_trials"],
@@ -1328,6 +1345,7 @@ def serving_main(argv=None) -> int:
         "unit": "req/s",
         "platform": platform,
         "platform_fallback": platform == "cpu" and not platform_forced,
+        **process_stamp(),
         "requests_per_s": res["requests_per_s"],
         "p50_latency_ms": res["p50_latency_ms"],
         "p99_latency_ms": res["p99_latency_ms"],
@@ -1344,6 +1362,274 @@ def serving_main(argv=None) -> int:
     if path:
         log(f"[bench] ledger: serving record appended to {path}")
     return 0
+
+
+def _mh_sources(n: int, events_per_int: int, n_int: int = 4):
+    """Deterministic synthetic survey batch for the multi-host bench: the
+    same seed on every process (and every process count) so the 1/2/4-
+    process fold outputs are comparable bitwise."""
+    from crimp_tpu.models import timing
+
+    rng = np.random.RandomState(13)
+    edges = np.linspace(58000.0, 58008.0, n_int + 1)
+    tms, seg_lists = [], []
+    for i in range(n):
+        tms.append(timing.from_dict({"PEPOCH": 58000.0,
+                                     "F0": 0.1 + 0.002 * (i % 97),
+                                     "F1": -1e-13}))
+        seg_lists.append(
+            [np.sort(rng.uniform(lo + 1e-6, hi - 1e-6, events_per_int))
+             for lo, hi in zip(edges[:-1], edges[1:])])
+    return tms, seg_lists
+
+
+def _multihost_worker(args) -> int:
+    """One process of an N-process localhost job (bench_multihost --worker).
+
+    Joins the jax.distributed job described by CRIMP_TPU_DIST, runs the
+    fixed-size parity workload (hashes comparable across process counts)
+    and the weak-scaled throughput workload (problem size proportional to
+    the process count), and — on process 0 only — prints one JSON result
+    line to stdout. All chatter goes to stderr.
+    """
+    import hashlib
+
+    from crimp_tpu.parallel import multihost
+
+    pidx, pcount = multihost.ensure_distributed()
+    import jax
+
+    from crimp_tpu.ops import multisource
+    from crimp_tpu.parallel import mesh as pmesh
+
+    def tree_hash(tree) -> str:
+        h = hashlib.sha1()
+        for leaf in jax.tree_util.tree_leaves(tree):
+            h.update(np.ascontiguousarray(
+                np.asarray(leaf, dtype=np.float64)).tobytes())
+        return h.hexdigest()
+
+    fdots = np.array([-2e-14, -1e-14])
+
+    # -- parity workload: FIXED size, so its outputs must be bitwise
+    #    identical whatever the process count (the event psum never
+    #    crosses a host; trial sharding rides the order-insensitive MXU
+    #    tile path; fold is elementwise per source row) -------------------
+    rng = np.random.RandomState(7)
+    t_par = np.sort(rng.uniform(0.0, 30.0, args.parity_events)) * 86400.0
+    f_par = np.linspace(0.1430, 0.1436, args.parity_freqs)
+    # the GENERAL kernel shards the literal frequency array, so every
+    # process count sees bit-identical trial values; the uniform-grid
+    # fastpath re-derives shard frequencies from axis_index, which can
+    # differ in the last ulp across shard offsets
+    grid = np.asarray(pmesh.z2_2d_sharded(t_par, f_par, fdots,
+                                          use_fastpath=False))
+    grid_hash = hashlib.sha1(np.ascontiguousarray(grid).tobytes()).hexdigest()
+    tms_p, segs_p = _mh_sources(args.parity_sources, 120)
+    fold_hash = tree_hash(multisource.fold_sources(tms_p, segs_p))
+
+    # -- weak-scaled throughput: trials and sources grow with the process
+    #    count, so flat wall clock = linear aggregate throughput ----------
+    n_freq_total = args.n_freq * pcount
+    f_w = np.linspace(0.1430, 0.1436, n_freq_total)
+    t_w = np.sort(rng.uniform(0.0, 30.0, args.events)) * 86400.0
+    pmesh.z2_2d_sharded(t_w, f_w, fdots)  # compile
+    wall = np.inf
+    for _ in range(2):
+        t0 = time.perf_counter()
+        np.asarray(pmesh.z2_2d_sharded(t_w, f_w, fdots))
+        wall = min(wall, time.perf_counter() - t0)
+    trials_per_s = n_freq_total * len(fdots) / wall
+
+    n_sources_total = args.sources * pcount
+    tms_w, segs_w = _mh_sources(n_sources_total, args.events_per_int)
+    multisource.fold_sources(tms_w, segs_w)  # compile
+    wall_s = np.inf
+    for _ in range(2):
+        t0 = time.perf_counter()
+        multisource.fold_sources(tms_w, segs_w)
+        wall_s = min(wall_s, time.perf_counter() - t0)
+    sources_per_s = n_sources_total / wall_s
+
+    log(f"[bench] multihost worker {pidx}/{pcount}: "
+        f"{trials_per_s:.0f} trials/s, {sources_per_s:.1f} sources/s")
+    if pidx == 0:
+        print(json.dumps({
+            "nproc": pcount,
+            "local_devices": len(jax.local_devices()),
+            "grid_hash": grid_hash,
+            "grid_argmax": int(np.argmax(grid)),
+            "fold_hash": fold_hash,
+            "trials_per_s": round(trials_per_s, 1),
+            "sources_per_s": round(sources_per_s, 2),
+            "n_freq_total": n_freq_total,
+            "n_sources_total": n_sources_total,
+        }), flush=True)
+    return 0
+
+
+def multihost_main(argv=None) -> int:
+    """``python bench.py bench_multihost`` — N-process weak-scaling bench.
+
+    The orchestrator launches 1-, 2- and 4-process localhost
+    ``jax.distributed`` jobs (CPU backend, gloo collectives, a fixed
+    per-process virtual device count so the event-psum grouping never
+    changes), checks that the fixed-size parity workload hashes bitwise
+    identically across every process count, measures weak-scaled
+    ``trials_per_s``/``sources_per_s``, and appends one
+    process-count-stamped ledger record per configuration. The
+    single-process baseline runs as a subprocess worker too, so all
+    configurations pay identical bring-up overhead.
+
+    Exit 0 = every configuration completed and parity held. The >1.5x
+    aggregate-throughput expectation at 4 processes only applies when the
+    host actually has cores to scale onto — the record stamps ``cores``
+    and ``core_limited`` so a core-starved CI box reports honestly
+    instead of faking a scaling result.
+    """
+    import argparse
+    import os
+    import socket
+    import subprocess
+
+    from crimp_tpu.obs import ledger as obs_ledger
+
+    ap = argparse.ArgumentParser(prog="bench.py bench_multihost")
+    ap.add_argument("--worker", type=int, default=None,
+                    help="internal: run as one process of the distributed "
+                         "job described by CRIMP_TPU_DIST")
+    ap.add_argument("--procs", default="1,2,4",
+                    help="comma-separated process counts to measure")
+    ap.add_argument("--local-devices", type=int, default=2,
+                    help="virtual CPU devices per process (fixed across "
+                         "configs so the event psum grouping is identical)")
+    ap.add_argument("--events", type=int, default=20_000)
+    ap.add_argument("--n-freq", type=int, default=128,
+                    help="per-process frequency trials (weak scaling)")
+    ap.add_argument("--sources", type=int, default=16,
+                    help="per-process survey sources (weak scaling)")
+    ap.add_argument("--events-per-int", type=int, default=200)
+    ap.add_argument("--parity-events", type=int, default=2048)
+    ap.add_argument("--parity-freqs", type=int, default=64)
+    ap.add_argument("--parity-sources", type=int, default=8)
+    ap.add_argument("--timeout-s", type=float, default=900.0)
+    args = ap.parse_args(argv)
+    if args.worker is not None:
+        return _multihost_worker(args)
+
+    configs = [int(p) for p in args.procs.split(",") if p.strip()]
+    here = os.path.abspath(__file__)
+    results: dict[int, dict] = {}
+    failures: dict[int, str] = {}
+    for nproc in configs:
+        with socket.socket() as s:  # a free localhost port per config
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = ("--xla_force_host_platform_device_count="
+                            f"{args.local_devices}")
+        # pin the grid blocking: an autotuner winner that differs between
+        # configs would change the reduction tiling and break the bitwise
+        # parity contract
+        env["CRIMP_TPU_GRID_BLOCKS"] = "256,4"
+        forward = ["--procs", str(nproc),
+                   "--local-devices", str(args.local_devices),
+                   "--events", str(args.events),
+                   "--n-freq", str(args.n_freq),
+                   "--sources", str(args.sources),
+                   "--events-per-int", str(args.events_per_int),
+                   "--parity-events", str(args.parity_events),
+                   "--parity-freqs", str(args.parity_freqs),
+                   "--parity-sources", str(args.parity_sources)]
+        procs = []
+        for k in range(nproc):
+            env_k = dict(env)
+            env_k["CRIMP_TPU_DIST"] = f"localhost:{port},{nproc},{k}"
+            procs.append(subprocess.Popen(
+                [sys.executable, here, "bench_multihost",
+                 "--worker", str(k)] + forward,
+                stdout=subprocess.PIPE if k == 0 else subprocess.DEVNULL,
+                env=env_k, cwd=os.path.dirname(here)))
+        try:
+            out, _ = procs[0].communicate(timeout=args.timeout_s)
+            for p in procs[1:]:
+                p.wait(timeout=60.0)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            failures[nproc] = f"timeout after {args.timeout_s:g}s"
+            log(f"[bench] multihost p{nproc}: TIMEOUT")
+            continue
+        rcs = [p.returncode for p in procs]
+        doc = None
+        for line in (out or b"").decode(errors="replace").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        if any(rcs) or not isinstance(doc, dict):
+            failures[nproc] = f"worker rcs {rcs}, record={'yes' if doc else 'no'}"
+            log(f"[bench] multihost p{nproc}: FAILED ({failures[nproc]})")
+            continue
+        results[nproc] = doc
+        log(f"[bench] multihost p{nproc}: {doc['trials_per_s']:.0f} trials/s, "
+            f"{doc['sources_per_s']:.1f} sources/s")
+
+    # bitwise parity across process counts (the fixed-size workload)
+    hashes = {(r["grid_hash"], r["grid_argmax"], r["fold_hash"])
+              for r in results.values()}
+    parity_ok = len(results) == len(configs) and len(hashes) == 1
+
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    core_limited = cores < max(configs) * args.local_devices
+    base = results.get(configs[0])
+    scaling = {
+        str(n): (round(results[n]["trials_per_s"] / base["trials_per_s"], 3)
+                 if base and n in results and base["trials_per_s"] else None)
+        for n in configs}
+    record = {
+        "metric": "multihost_weak_scaling",
+        "unit": "trials/s",
+        "platform": "cpu",
+        # the orchestrator PINS the cpu backend for its localhost workers;
+        # this is the operator-forced case, not a silent fallback
+        "platform_fallback": False,
+        **process_stamp(),
+        "procs": configs,
+        "local_devices_per_proc": args.local_devices,
+        "cores": cores,
+        "core_limited": core_limited,
+        "parity_ok": parity_ok,
+        "scaling_vs_p1": scaling,
+        "configs": {str(n): results[n] for n in results},
+        "failures": {str(n): failures[n] for n in failures},
+    }
+    print(json.dumps(record), flush=True)
+    for nproc, res in results.items():
+        entry = {
+            "metric": "multihost_weak_scaling",
+            "unit": "trials/s",
+            "platform": "cpu",
+            "platform_fallback": False,
+            "process_index": 0,
+            "process_count": nproc,
+            "trials_per_s": res["trials_per_s"],
+            "sources_per_s": res["sources_per_s"],
+            "parity_ok": parity_ok,
+            "core_limited": core_limited,
+        }
+        path = obs_ledger.append_bench_record(
+            entry, source=f"bench.py bench_multihost p{nproc}")
+        if path:
+            log(f"[bench] ledger: multihost p{nproc} record appended to "
+                f"{path}")
+    return 0 if parity_ok else 1
 
 
 def bench_north_star(par_path: str, template_path: str, times: np.ndarray, intervals,
@@ -1636,6 +1922,7 @@ def main():
             "metric": "toa_extraction_throughput_84toa_res1000",
             "value": None, "unit": "ToA/s", "vs_baseline": None,
             "platform": platform, "platform_fallback": platform_fallback,
+            **process_stamp(),
             "obs_manifest": obs_manifest_path(),
             "obs_schema_version": obs.OBS_SCHEMA_VERSION,
             "errors": errors,
@@ -1724,6 +2011,7 @@ def main():
         ),
         "platform": platform,
         "platform_fallback": platform_fallback,
+        **process_stamp(),
         "obs_manifest": obs_manifest_path(),
         "obs_schema_version": obs.OBS_SCHEMA_VERSION,
         # per-kernel efficiency-of-peak headline (obs/roofline.py joins the
@@ -1831,4 +2119,6 @@ if __name__ == "__main__":
         sys.exit(serving_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "bench_jerk":
         sys.exit(jerk_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "bench_multihost":
+        sys.exit(multihost_main(sys.argv[2:]))
     main()

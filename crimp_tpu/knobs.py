@@ -141,6 +141,15 @@ REGISTRY: dict[str, Knob] = _build_registry((
     Knob("CRIMP_TPU_MULTISOURCE_BATCH", "unset (resolved source block)", "int",
          consumer="ops/multisource.py via ops/autotune.py",
          doc="hard cap on sources per batched survey dispatch (0 = no cap)"),
+    # -- multi-host execution (bit-identical by construction: the host axis
+    #    carries trials/sources, never a reduction — GL005 + the 1/2/4-
+    #    process bitwise pins in tests/test_multihost_smoke.py) ------------
+    Knob("CRIMP_TPU_DIST", "unset (single process)", "str",
+         consumer="parallel/multihost.py",
+         doc="jax.distributed bring-up spec 'coordinator:port,num_processes,"
+             "process_id'; unset/off = single-process. CPU backends get the "
+             "gloo collectives implementation so localhost N-process jobs "
+             "(bench_multihost, the multiproc test tier) can psum"),
     # -- observability (host-side telemetry; numeric-neutral by contract) ---
     Knob("CRIMP_TPU_OBS", "unset (off)", "bool", consumer="crimp_tpu/obs",
          doc="flight-recorder telemetry: spans/counters + an atomic run manifest"),

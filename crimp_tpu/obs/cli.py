@@ -92,6 +92,10 @@ def build_parser() -> argparse.ArgumentParser:
     mg.add_argument("-o", "--out", default=None,
                     help="output path (default: <run_id>.merged."
                          "manifest.json next to the first stream)")
+    mg.add_argument("--run-id", default=None,
+                    help="with a directory target: merge this run's host "
+                         "group instead of the newest one (a unique "
+                         "substring of the id is enough)")
     mg.add_argument("--force", action="store_true",
                     help="join streams whose run_ids disagree (clock skew "
                          "at the stamp second)")
@@ -269,7 +273,7 @@ def main(argv: list[str] | None = None) -> int:
             return 0
 
         if args.cmd == "merge":
-            streams = mrg.resolve_streams(args.streams)
+            streams = mrg.resolve_streams(args.streams, run_id=args.run_id)
             out = mrg.merge_file(streams, args.out, force=args.force)
             doc = load_manifest(out)  # a merge that fails validation is a bug
             print(out)

@@ -198,9 +198,22 @@ def analyze(fn, args: tuple, kwargs: dict, plan=None) -> dict:
         row["reduce_axes"] = list(plan.rule.reduce_axes)
         try:
             outs = jax.tree_util.tree_leaves(lowered.out_info)
-            row["collective_bytes"] = float(plan.collective_bytes(outs))
+            split = plan.collective_bytes_split(outs)
+            row["collective_bytes"] = float(split["ici"] + split["dcn"])
+            row["collective_bytes_ici"] = float(split["ici"])
+            row["collective_bytes_dcn"] = float(split["dcn"])
         except Exception:  # noqa: BLE001 — out_info is jax-version-dependent  # graftlint: disable=GL006 (telemetry guard: collective accounting degrades to None on jax builds without lowered.out_info)
             row["collective_bytes"] = None
+            row["collective_bytes_ici"] = None
+            row["collective_bytes_dcn"] = None
+    # per-host rows: under multi-process dispatch every host captures its
+    # own row; the stamps keep `obs merge` from folding hosts together
+    try:
+        from crimp_tpu.parallel import multihost
+        row["process_index"], row["process_count"] = \
+            multihost.process_identity()
+    except Exception:  # noqa: BLE001 — identity is best-effort telemetry  # graftlint: disable=GL006 (telemetry guard: process identity must never fail a capture)
+        row["process_index"], row["process_count"] = 0, 1
     try:
         ca = compiled.cost_analysis()
     except Exception:  # noqa: BLE001 — backend-dependent analysis  # graftlint: disable=GL006 (telemetry guard: cost_analysis is absent on some PJRT backends; partial rows are the contract)

@@ -119,7 +119,12 @@ def extract_metrics(record: dict) -> dict[str, float]:
 def entry_from_record(record: dict | None, *, source: str, kind: str = "bench",
                       round_n: int | None = None,
                       rc: int | None = None) -> dict:
-    """Normalize one bench record (or its absence) into a ledger entry."""
+    """Normalize one bench record (or its absence) into a ledger entry.
+
+    The ``process_index``/``process_count`` stamps ride along (defaulting
+    to the single-process identity for records predating the stamp) so the
+    green baseline never mixes single-host and N-host rates — ``check()``
+    gates a candidate only against greens with the same process count."""
     rec = record if isinstance(record, dict) else {}
     return {
         "schema": LEDGER_SCHEMA,
@@ -132,6 +137,8 @@ def entry_from_record(record: dict | None, *, source: str, kind: str = "bench",
         "platform": rec.get("platform"),
         "platform_fallback": rec.get("platform_fallback"),
         "carried": bool(rec.get("carried")),
+        "process_index": int(rec.get("process_index") or 0),
+        "process_count": int(rec.get("process_count") or 1),
         "metrics": extract_metrics(rec),
     }
 
@@ -299,13 +306,27 @@ def check(entries: list[dict], tolerance_pct: float = 5.0) -> dict:
     baseline and the check passes. Non-green entries are reported as
     excluded. A metric regresses when it is worse than baseline by more
     than ``tolerance_pct`` percent in its metric's bad direction.
+
+    Greens whose ``process_count`` differs from the candidate's are
+    excluded from its baseline (reported with class
+    ``other_process_count``): a 4-host aggregate rate must never gate —
+    or be gated by — a single-host run of the same metric.
     """
     ordered = _ordered(entries)
     greens = [e for e in ordered
               if e.get("class") in GREEN_CLASSES and e.get("metrics")]
+    if greens:
+        cand_pc = int(greens[-1].get("process_count") or 1)
+        mismatched = [e for e in greens[:-1]
+                      if int(e.get("process_count") or 1) != cand_pc]
+        greens = [e for e in greens if e not in mismatched]
+    else:
+        mismatched = []
     excluded = [{"source": e.get("source"), "round": e.get("round"),
                  "class": e.get("class")}
                 for e in ordered if e.get("class") not in GREEN_CLASSES]
+    excluded += [{"source": e.get("source"), "round": e.get("round"),
+                  "class": "other_process_count"} for e in mismatched]
     report = {
         "tolerance_pct": tolerance_pct,
         "entries": len(entries),

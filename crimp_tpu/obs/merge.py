@@ -36,13 +36,16 @@ from crimp_tpu.obs import salvage as slv
 _HOST_STEM_RE = re.compile(r"\.host(\d+)$")
 
 
-def resolve_streams(targets: list[str]) -> list[str]:
+def resolve_streams(targets: list[str],
+                    run_id: str | None = None) -> list[str]:
     """Expand CLI targets into event-stream paths.
 
-    A single directory target selects the newest run's streams: all
+    A single directory target selects one run's host streams: all
     ``*.events.jsonl`` are grouped by run_id (the stem with any
-    ``.host<k>`` suffix stripped) and the most recently touched group
-    wins. Explicit file lists pass through untouched.
+    ``.host<k>`` suffix stripped). With ``run_id`` the matching group is
+    chosen (exact stem, else unique substring — enough of the id to be
+    unambiguous works); otherwise the most recently touched group wins.
+    Explicit file lists pass through untouched.
     """
     if len(targets) == 1 and os.path.isdir(targets[0]):
         streams = glob.glob(os.path.join(targets[0], "*.events.jsonl"))
@@ -53,9 +56,23 @@ def resolve_streams(targets: list[str]) -> list[str]:
             stem = os.path.basename(s)[: -len(".events.jsonl")]
             stem = _HOST_STEM_RE.sub("", stem)
             groups.setdefault(stem, []).append(s)
+        if run_id is not None:
+            if run_id in groups:
+                return sorted(groups[run_id])
+            hits = [k for k in groups if run_id in k]
+            if len(hits) != 1:
+                raise FileNotFoundError(
+                    f"{targets[0]}: run_id {run_id!r} matches "
+                    f"{sorted(hits) if hits else 'no'} stream group(s) of "
+                    f"{sorted(groups)}")
+            return sorted(groups[hits[0]])
         best = max(groups.values(),
                    key=lambda g: max(os.path.getmtime(s) for s in g))
         return sorted(best)
+    if run_id is not None:
+        raise ValueError(
+            "obs merge: --run-id selects a group within a directory "
+            "target; drop it when listing stream files explicitly")
     return list(targets)
 
 

@@ -33,34 +33,46 @@ from crimp_tpu.obs.manifest import span_paths
 # ici_bytes_per_s = aggregate per-chip inter-chip-interconnect bandwidth
 # (approximate — the spec sheets quote per-link Gbps and link counts vary
 # by topology slice); it prices the ring all-reduce the sharded kernels'
-# collective_bytes estimate assumes.
+# collective_bytes estimate assumes. dcn_bytes_per_s = per-host
+# data-center-network bandwidth (the inter-slice/inter-host leg of a
+# multi-process mesh; ~200 Gbps NICs on current TPU hosts, ~100 Gbps on
+# older generations) — it prices the cross-host leg of
+# collective_bytes_split, which on the host-major mesh should be ZERO for
+# the event psum; a non-zero DCN leg is the layout bug the verdict flags.
 PEAKS: tuple[tuple[str, dict], ...] = (
     ("v6", {"flops": 918e12, "bytes_per_s": 1.64e12,
             "ici_bytes_per_s": 448e9,
+            "dcn_bytes_per_s": 25e9,
             "source": "TPU v6e spec (bf16 dense, HBM 1640 GB/s, "
                       "ICI ~448 GB/s approx)"}),
     ("v5p", {"flops": 459e12, "bytes_per_s": 2.765e12,
              "ici_bytes_per_s": 600e9,
+             "dcn_bytes_per_s": 25e9,
              "source": "TPU v5p spec (bf16 dense, HBM 2765 GB/s, "
                        "ICI ~600 GB/s approx)"}),
     ("v5", {"flops": 197e12, "bytes_per_s": 8.19e11,
             "ici_bytes_per_s": 200e9,
+            "dcn_bytes_per_s": 12.5e9,
             "source": "TPU v5e spec (bf16 dense, HBM 819 GB/s, "
                       "ICI ~200 GB/s approx)"}),
     ("v4", {"flops": 275e12, "bytes_per_s": 1.228e12,
             "ici_bytes_per_s": 300e9,
+            "dcn_bytes_per_s": 12.5e9,
             "source": "TPU v4 spec (bf16 dense, HBM 1228 GB/s, "
                       "ICI ~300 GB/s approx)"}),
     ("v3", {"flops": 123e12, "bytes_per_s": 9.0e11,
             "ici_bytes_per_s": 140e9,
+            "dcn_bytes_per_s": 12.5e9,
             "source": "TPU v3 spec (bf16 dense, HBM 900 GB/s, "
                       "ICI ~140 GB/s approx)"}),
     ("v2", {"flops": 45e12, "bytes_per_s": 7.0e11,
             "ici_bytes_per_s": 62.5e9,
+            "dcn_bytes_per_s": 12.5e9,
             "source": "TPU v2 spec (bf16 dense, HBM 700 GB/s, "
                       "ICI ~62.5 GB/s approx)"}),
     ("cpu", {"flops": 1e11, "bytes_per_s": 5e10,
              "ici_bytes_per_s": 1e10,
+             "dcn_bytes_per_s": 1e9,
              "source": "CPU fallback placeholder (order of magnitude: one "
                        "AVX2-class core + DDR channel; 'ICI' = shared "
                        "memory fabric placeholder)"}),
@@ -120,10 +132,14 @@ def analyze(doc: dict) -> dict:
     additionally carry ``devices``, the aggregate achieved rates
     (``agg_flops_per_s``/``agg_bytes_per_s`` = per-device x devices),
     ``collective_bytes_per_call`` (the registry's ring all-reduce
-    estimate), and ``comm_vs_roof`` — the ratio of the estimated
-    collective time (collective bytes over ICI bandwidth) to the
-    per-device compute/memory roofline time; above 1.0 the verdict flips
-    to ``bound = "comm"``. When any sharded row exists, ``aggregate``
+    estimate, split into ``collective_bytes_ici``/``collective_bytes_dcn``
+    legs on manifests captured under a multi-process mesh), and
+    ``comm_vs_roof`` — the ratio of the estimated collective time (each
+    leg priced at its own bandwidth: ICI within a host, DCN across
+    hosts) to the per-device compute/memory roofline time; above 1.0 the
+    verdict flips to ``bound = "comm"``, with ``comm_leg`` naming the
+    dominant leg. Rows captured on a multi-process run carry their
+    ``process_index``/``process_count`` stamps (per-host rows). When any sharded row exists, ``aggregate``
     holds the N-device roofline (single-chip peaks x the widest row's
     device count; per-row pct_of_roof is per-device and is unchanged by
     that uniform scaling). Fields degrade to None wherever the manifest
@@ -170,18 +186,34 @@ def analyze(doc: dict) -> dict:
         ndev = int(ndev) if isinstance(ndev, (int, float)) and ndev >= 1 else 1
         coll = cost.get("collective_bytes")
         coll = float(coll) if isinstance(coll, (int, float)) else None
+        coll_ici = cost.get("collective_bytes_ici")
+        coll_ici = (float(coll_ici)
+                    if isinstance(coll_ici, (int, float)) else None)
+        coll_dcn = cost.get("collective_bytes_dcn")
+        coll_dcn = (float(coll_dcn)
+                    if isinstance(coll_dcn, (int, float)) else None)
+        if coll is not None and coll_ici is None:
+            # pre-split manifests: the whole estimate rode ICI
+            coll_ici, coll_dcn = coll, 0.0
         comm_vs_roof = None
+        comm_leg = None
         if ndev > 1 and peak and peak.get("ici_bytes_per_s") \
-                and coll is not None \
+                and coll_ici is not None \
                 and isinstance(flops, (int, float)) \
                 and isinstance(nbytes, (int, float)):
             # per-device, per-call: the time the collective needs on the
-            # interconnect vs the time the compute/memory roofline grants
+            # interconnect (ICI leg + DCN leg, each priced at its own
+            # bandwidth) vs the time the compute/memory roofline grants
             # the kernel body — whichever dominates names the binding
             # resource
             t_roof = max(flops / peak["flops"], nbytes / peak["bytes_per_s"])
+            t_ici = coll_ici / peak["ici_bytes_per_s"]
+            t_dcn = ((coll_dcn or 0.0)
+                     / (peak.get("dcn_bytes_per_s") or peak["ici_bytes_per_s"]))
             if t_roof > 0:
-                comm_vs_roof = (coll / peak["ici_bytes_per_s"]) / t_roof
+                comm_vs_roof = (t_ici + t_dcn) / t_roof
+                if t_ici or t_dcn:
+                    comm_leg = "dcn" if t_dcn > t_ici else "ici"
                 if comm_vs_roof > 1.0:
                     bound = "comm"
         rows.append({
@@ -199,8 +231,13 @@ def analyze(doc: dict) -> dict:
             "agg_flops_per_s": fps * ndev if fps is not None else None,
             "agg_bytes_per_s": bps * ndev if bps is not None else None,
             "collective_bytes_per_call": coll,
+            "collective_bytes_ici": coll_ici,
+            "collective_bytes_dcn": coll_dcn,
             "comm_vs_roof": (round(comm_vs_roof, 3)
                              if comm_vs_roof is not None else None),
+            "comm_leg": comm_leg,
+            "process_index": cost.get("process_index"),
+            "process_count": cost.get("process_count"),
             "peak_bytes": cost.get("peak_bytes"),
             "span": cost.get("span"),
         })
@@ -215,6 +252,7 @@ def analyze(doc: dict) -> dict:
             "flops": peak["flops"] * n,
             "bytes_per_s": peak["bytes_per_s"] * n,
             "ici_bytes_per_s": peak.get("ici_bytes_per_s"),
+            "dcn_bytes_per_s": peak.get("dcn_bytes_per_s"),
         }
     return {
         "run_id": doc.get("run_id"),
@@ -277,18 +315,31 @@ def render(analysis: dict, top: int = 20) -> str:
             f"sharded  {agg['devices']}-device aggregate roof: "
             f"{_eng(agg['flops'], 'FLOP/s')}  "
             f"{_eng(agg['bytes_per_s'], 'B/s')}  "
-            f"ici {_eng(agg.get('ici_bytes_per_s'), 'B/s')}")
+            f"ici {_eng(agg.get('ici_bytes_per_s'), 'B/s')}  "
+            f"dcn {_eng(agg.get('dcn_bytes_per_s'), 'B/s')}")
         for r in rows[:top]:
             if r.get("devices", 1) <= 1:
                 continue
             ratio = r.get("comm_vs_roof")
+            coll = (f"collective ici "
+                    f"{_eng(r.get('collective_bytes_ici'), 'B')}"
+                    f" + dcn {_eng(r.get('collective_bytes_dcn'), 'B')}/call"
+                    if r.get("collective_bytes_ici") is not None
+                    else "collective "
+                    f"{_eng(r['collective_bytes_per_call'], 'B')}/call")
+            host = ""
+            if isinstance(r.get("process_count"), int) \
+                    and r["process_count"] > 1:
+                host = (f"  host {r.get('process_index')}"
+                        f"/{r['process_count']}")
+            leg = f" [{r['comm_leg']}]" if r.get("comm_leg") else ""
             lines.append(
                 f"  {r['name']}: x{r['devices']}  "
                 f"agg {_eng(r['agg_flops_per_s'], 'F/s')}  "
-                f"collective {_eng(r['collective_bytes_per_call'], 'B')}/call"
+                f"{coll}"
                 f"  t_comm/t_roof "
-                f"{ratio if ratio is not None else '?'}"
-                f"  {(r['bound'] or '?') + '-bound'}")
+                f"{ratio if ratio is not None else '?'}{leg}"
+                f"  {(r['bound'] or '?') + '-bound'}{host}")
     worst = analysis.get("worst_pct")
     if worst is not None:
         lines.append(f"worst measured kernel: {worst:.2f}% of roof")

@@ -280,7 +280,13 @@ def fold_sources(timing_models, seg_times_list, t_ref_list=None):
         sm, delta_dev, idx_dev, n_real, plan = _maybe_shard_sources(
             sm, delta_pad, idx_pad
         )
-        rows = np.asarray(stacked_fold(sm, delta_dev, idx_dev))[:n_real]
+        # fetch_global is np.asarray on a single process; on a multi-process
+        # job it is the one tiled allgather that brings every host's fold
+        # rows back (the source axis's only DCN traffic)
+        from crimp_tpu.parallel import multihost
+
+        rows = multihost.fetch_global(
+            stacked_fold(sm, delta_dev, idx_dev))[:n_real]
         # sharded chunks cost-model too: the committed shardings survive
         # abstraction (obs/costmodel._abstractify), so the AOT lowering is
         # the same per-device program the dispatch above just ran
@@ -302,9 +308,19 @@ def _maybe_shard_sources(sm: StackedAnchoredModel, delta: np.ndarray,
     """Shard the source axis across devices when it pays (pure data
     parallelism; bitwise identical to the unsharded dispatch). Returns
     possibly-padded (sm, delta, idx), the real row count, and the registry
-    sharding plan (None when the dispatch stays on one device)."""
+    sharding plan (None when the dispatch stays on one device).
+
+    On a multi-process job the source axis spans HOSTS: the stacked batch
+    lands on the host-major global source mesh, and each process hands the
+    bridge only its own contiguous row block
+    (``multihost.process_local_rows`` + ``jax.make_array_from_process_
+    local_data``) — no host ever materializes the global batch on device.
+    The fold stays elementwise per row, so the cross-host layout is
+    bitwise identical to the single-process dispatch at equal padded
+    shapes (the 1/2/4-process pins in tests/test_multihost_smoke.py).
+    """
     from crimp_tpu.parallel import mesh as pmesh
-    from crimp_tpu.parallel import registry
+    from crimp_tpu.parallel import multihost, registry
 
     n = sm.n_source
     if not pmesh.sharding_enabled():
@@ -312,7 +328,9 @@ def _maybe_shard_sources(sm: StackedAnchoredModel, delta: np.ndarray,
     n_devices = len(jax.devices())
     if n_devices < 2 or n < n_devices:
         return sm, jnp.asarray(delta), jnp.asarray(idx), n, None
-    smesh = pmesh.source_mesh()
+    _, pcount = multihost.process_identity()
+    smesh = multihost.global_source_mesh() if pcount > 1 \
+        else pmesh.source_mesh()
     plan = registry.specs_for("stacked_fold", smesh)
     pad = pmesh.pad_batch_for_mesh(n, smesh, axis_name=pmesh.SOURCE_AXIS)
     if pad:
@@ -320,8 +338,17 @@ def _maybe_shard_sources(sm: StackedAnchoredModel, delta: np.ndarray,
         delta = np.concatenate([delta, np.zeros((pad,) + delta.shape[1:])])
         idx = np.concatenate([idx, np.zeros((pad,) + idx.shape[1:], idx.dtype)])
 
-    def put(name, arr):
-        return jax.device_put(np.asarray(arr), plan.named(name))
+    if pcount > 1:
+        lo, hi = multihost.process_local_rows(n + pad)
+
+        def put(name, arr):
+            arr = np.asarray(arr)
+            return multihost.global_array(arr[lo:hi], smesh,
+                                          plan.spec(name, leaf=arr),
+                                          arr.shape)
+    else:
+        def put(name, arr):
+            return jax.device_put(np.asarray(arr), plan.named(name))
 
     sm = StackedAnchoredModel(
         **{name: put(name, getattr(sm, name)) for name in _FIELDS}

@@ -132,18 +132,30 @@ def build_mesh(
     return Mesh(grid, axis_names)
 
 
+def _default_1d_devices():
+    """Device list for the 1-D data-parallel meshes: all devices on a
+    single-process job, THIS HOST'S devices on a multi-process one. The
+    legacy segment/source paths place host arrays with ``jax.device_put``,
+    which cannot address another process's devices — the multi-process
+    twins route through ``parallel.multihost`` (global source mesh +
+    process-local->global bridge) instead."""
+    from crimp_tpu.parallel.multihost import process_identity
+
+    return jax.local_devices() if process_identity()[1] > 1 else jax.devices()
+
+
 def segment_mesh(devices=None) -> Mesh:
-    """A 1-D mesh over all (or given) devices for segment-batched fits."""
+    """A 1-D mesh over all (or this host's) devices for segment-batched fits."""
     if devices is None:
-        devices = jax.devices()
+        devices = _default_1d_devices()
     return Mesh(np.asarray(devices), (SEGMENT_AXIS,))
 
 
 def source_mesh(devices=None) -> Mesh:
-    """A 1-D mesh over all (or given) devices for source-batched survey
+    """A 1-D mesh over all (or this host's) devices for source-batched survey
     dispatches (ops/multisource stacked folds)."""
     if devices is None:
-        devices = jax.devices()
+        devices = _default_1d_devices()
     return Mesh(np.asarray(devices), (SOURCE_AXIS,))
 
 
@@ -157,6 +169,45 @@ def shard_sources(array, mesh: Mesh):
     ToA-segment fits)."""
     return jax.device_put(np.asarray(array),
                           leading_axis_sharding(mesh, SOURCE_AXIS))
+
+
+def default_dispatch_mesh() -> Mesh:
+    """The mesh the sharded twins dispatch on when the caller passes none:
+    the host-major 2-D (events x trials) global mesh on a multi-process
+    job — trials across hosts over DCN, the per-block event psum confined
+    to each host's local devices — else the classic all-devices-on-events
+    mesh."""
+    from crimp_tpu.parallel import multihost
+
+    if multihost.process_identity()[1] > 1:
+        return multihost.global_grid_mesh()
+    return build_mesh()
+
+
+def _to_mesh(arr, mesh: Mesh, plan, param: str):
+    """Host array -> device array laid out by the registry plan.
+
+    Single-process meshes take the plain ``jnp.asarray`` commit the twins
+    always used; a mesh spanning processes needs every host-side input
+    placed explicitly (each addressable device gets exactly its shard via
+    the callback bridge — event/trial inputs are host-replicated, so
+    every process holds the full host array)."""
+    from crimp_tpu.parallel import multihost
+
+    if multihost.spans_processes(mesh):
+        return multihost.replicated_array(np.asarray(arr), mesh,
+                                          plan.spec(param, leaf=arr))
+    return jnp.asarray(arr)
+
+
+def _materialize(x, mesh: Mesh) -> np.ndarray:
+    """Global-safe ``np.asarray``: results sharded across processes gather
+    through one tiled allgather (the trial axis's only DCN traffic)."""
+    from crimp_tpu.parallel import multihost
+
+    if multihost.spans_processes(mesh):
+        return multihost.fetch_global(x)
+    return np.asarray(x)
 
 
 def _pad_to(x: np.ndarray, multiple: int, fill=0.0):
@@ -331,27 +382,31 @@ def _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype, use_fastpath,
         # to small inputs exactly as it always shrank the static default.
         g_eb, g_tb = resolve_blocks("grid_mxu" if mx else "grid",
                                     ev_per_shard, tr_per_shard, poly)
-        gargs = (jnp.asarray(t_pad), jnp.asarray(w_pad), f0, df, n_freq_pad,
-                 fd, nharm, mesh)
+        plan = specs_for("sharded_sums_grid", mesh)
+        gargs = (_to_mesh(t_pad, mesh, plan, "times"),
+                 _to_mesh(w_pad, mesh, plan, "weights"), f0, df, n_freq_pad,
+                 _to_mesh(fd, mesh, plan, "fdots"), nharm, mesh)
         gkw = dict(event_block=_fit_block(g_eb, ev_per_shard),
                    trial_block=_fit_block(g_tb, tr_per_shard),
                    poly=poly, mxu=mx, reseed=rs, mxu_bf16=b16)
         c, s = _sharded_sums_grid(*gargs, **gkw)
         costmodel.capture("sharded_sums_grid", _sharded_sums_grid, *gargs,
-                          plan=specs_for("sharded_sums_grid", mesh), **gkw)
+                          plan=plan, **gkw)
     else:
         f_pad, _ = _pad_to(np.asarray(freqs, dtype=np.float64), tr_size, fill=1.0)
         d_eb, d_tb = resolve_blocks("general", ev_per_shard, tr_per_shard, poly)
-        gargs = (jnp.asarray(t_pad), jnp.asarray(w_pad), jnp.asarray(f_pad),
-                 fd, nharm, mesh)
+        plan = specs_for("sharded_sums_general", mesh)
+        gargs = (_to_mesh(t_pad, mesh, plan, "times"),
+                 _to_mesh(w_pad, mesh, plan, "weights"),
+                 _to_mesh(f_pad, mesh, plan, "freqs"),
+                 _to_mesh(fd, mesh, plan, "fdots"), nharm, mesh)
         gkw = dict(trig_dtype=trig_dtype,
                    event_block=_fit_block(d_eb, ev_per_shard),
                    trial_block=_fit_block(d_tb, tr_per_shard),
                    poly=poly)
         c, s = _sharded_sums_general(*gargs, **gkw)
         costmodel.capture("sharded_sums_general", _sharded_sums_general,
-                          *gargs,
-                          plan=specs_for("sharded_sums_general", mesh), **gkw)
+                          *gargs, plan=plan, **gkw)
     return c[:, :, :n_freq], s[:, :, :n_freq]
 
 
@@ -363,10 +418,10 @@ def z2_sharded(
 ) -> np.ndarray:
     """Z^2_n over the frequency grid, events sharded across the mesh."""
     if mesh is None:
-        mesh = build_mesh()
+        mesh = default_dispatch_mesh()
     c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype,
                             use_fastpath, poly, use_mxu, reseed, mxu_bf16)
-    return np.asarray(jnp.sum(z2_from_sums(c[0], s[0], len(times)), axis=0))  # graftlint: disable=GL005 (sums the replicated nharm axis, not the sharded event axis; per-trial order is fixed and the 8-device bitwise pin covers it)
+    return _materialize(jnp.sum(z2_from_sums(c[0], s[0], len(times)), axis=0), mesh)  # graftlint: disable=GL005 (sums the replicated nharm axis, not the sharded event axis; per-trial order is fixed and the 8-device bitwise pin covers it)
 
 
 def h_sharded(
@@ -377,12 +432,12 @@ def h_sharded(
 ) -> np.ndarray:
     """H-test over the frequency grid, events sharded across the mesh."""
     if mesh is None:
-        mesh = build_mesh()
+        mesh = default_dispatch_mesh()
     c, s = _sharded_sums_nd(times, freqs, 0.0, nharm, mesh, trig_dtype,
                             use_fastpath, poly, use_mxu, reseed, mxu_bf16)
     z2_cum = jnp.cumsum(z2_from_sums(c[0], s[0], len(times)), axis=0)
     penalties = 4.0 * jnp.arange(nharm)[:, None]
-    return np.asarray(jnp.max(z2_cum - penalties, axis=0))
+    return _materialize(jnp.max(z2_cum - penalties, axis=0), mesh)
 
 
 def z2_2d_sharded(
@@ -395,10 +450,10 @@ def z2_2d_sharded(
     across the mesh with psum combines (fdots replicated; the frequency axis
     shards over the trial mesh axis)."""
     if mesh is None:
-        mesh = build_mesh()
+        mesh = default_dispatch_mesh()
     c, s = _sharded_sums_nd(times, freqs, fdots, nharm, mesh, trig_dtype,
                             use_fastpath, poly, use_mxu, reseed, mxu_bf16)
-    return np.asarray(jnp.sum(z2_from_sums(c, s, len(times)), axis=1))  # graftlint: disable=GL005 (sums the replicated nharm axis, not the sharded event axis; per-trial order is fixed and the 8-device bitwise pin covers it)
+    return _materialize(jnp.sum(z2_from_sums(c, s, len(times)), axis=1), mesh)  # graftlint: disable=GL005 (sums the replicated nharm axis, not the sharded event axis; per-trial order is fixed and the 8-device bitwise pin covers it)
 
 
 def _sharded_sums_grid3d(
@@ -474,7 +529,7 @@ def z2_3d_sharded(
     falls back to the single-device general cube kernel (there is no general
     sharded kernel with a cubic phase family)."""
     if mesh is None:
-        mesh = build_mesh()
+        mesh = default_dispatch_mesh()
     grid = None
     if grid_fastpath_enabled(nharm, use_fastpath):
         grid = uniform_grid(freqs)
@@ -509,16 +564,19 @@ def z2_3d_sharded(
         use_mxu, reseed, mxu_bf16)
     g_eb, g_tb = resolve_blocks("grid_mxu" if mx else "grid3d",
                                 ev_per_shard, tr_per_shard, poly)
-    gargs = (jnp.asarray(t_pad), jnp.asarray(w_pad), f0, df, n_freq_pad,
-             fd, fdd, nharm, mesh)
+    plan3 = specs_for("sharded_sums_grid3d", mesh)
+    gargs = (_to_mesh(t_pad, mesh, plan3, "times"),
+             _to_mesh(w_pad, mesh, plan3, "weights"), f0, df, n_freq_pad,
+             _to_mesh(fd, mesh, plan3, "fdots"),
+             _to_mesh(fdd, mesh, plan3, "fddots"), nharm, mesh)
     gkw = dict(event_block=_fit_block(g_eb, ev_per_shard),
                trial_block=_fit_block(g_tb, tr_per_shard),
                poly=poly, mxu=mx, reseed=rs, mxu_bf16=b16)
     c, s = _sharded_sums_grid3d(*gargs, **gkw)
     costmodel.capture("sharded_sums_grid3d", _sharded_sums_grid3d, *gargs,
-                      plan=specs_for("sharded_sums_grid3d", mesh), **gkw)
+                      plan=plan3, **gkw)
     c, s = c[:, :, :, :n_freq], s[:, :, :, :n_freq]
-    return np.asarray(jnp.sum(z2_from_sums(c, s, len(times)), axis=2))  # graftlint: disable=GL005 (sums the replicated nharm axis, not the sharded event axis; per-trial order is fixed and the 8-device bitwise pin covers it)
+    return _materialize(jnp.sum(z2_from_sums(c, s, len(times)), axis=2), mesh)  # graftlint: disable=GL005 (sums the replicated nharm axis, not the sharded event axis; per-trial order is fixed and the 8-device bitwise pin covers it)
 
 
 def semicoherent_stack_sharded(

@@ -24,9 +24,16 @@ mesh-shape invariance — so these builders are pure performance layout.
 
 from __future__ import annotations
 
+import numpy as np
 from jax.sharding import Mesh
 
-from crimp_tpu.parallel.mesh import EVENT_AXIS, TRIAL_AXIS, build_mesh
+from crimp_tpu import knobs
+from crimp_tpu.parallel.mesh import (
+    EVENT_AXIS,
+    SOURCE_AXIS,
+    TRIAL_AXIS,
+    build_mesh,
+)
 
 
 def initialize(coordinator_address: str | None = None,
@@ -40,15 +47,65 @@ def initialize(coordinator_address: str | None = None,
     coordinator's ``host:port``, the process count, and this process's
     rank. Safe to document-and-skip on a single host: calling JAX
     without it simply keeps the local device view.
+
+    On CPU backends the collectives implementation is switched to gloo
+    first (the default CPU backend cannot run cross-process psums), so
+    localhost N-process jobs — bench_multihost, the multiproc test tier —
+    exercise the same global-mesh dispatch path a pod does.
     """
+    import os
+
     import jax
 
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — older jax without the option keeps its default  # graftlint: disable=GL006 (bring-up compat shim: a jax build without the gloo option simply keeps single-process semantics)
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
         **kwargs,
     )
+
+
+_DIST_STARTED = False
+
+
+def ensure_distributed() -> tuple[int, int]:
+    """Knob-driven bring-up: honor ``CRIMP_TPU_DIST``, return the identity.
+
+    The knob value is ``coordinator:port,num_processes,process_id`` (the
+    launcher stamps a distinct ``process_id`` per worker). Unset or an
+    off-word means single-process — nothing is initialized. Idempotent:
+    once the service is up (by this call or a real pod launcher) the call
+    only reports the identity, so library entry points may call it
+    unconditionally. The backend is brought up before returning —
+    ``process_identity`` deliberately never initializes one, and the
+    distributed service alone does not count as a live backend — so the
+    identity returned is the JOB's, not the pre-bring-up ``(0, 1)``.
+    """
+    global _DIST_STARTED
+
+    spec = knobs.raw("CRIMP_TPU_DIST")
+    if not spec or knobs.parse_onoff(spec) is False:
+        return process_identity()
+    live = process_identity()
+    if _DIST_STARTED or live != (0, 1):
+        return live  # already brought up (or a real pod job)
+    parts = [p.strip() for p in spec.split(",")]
+    if len(parts) != 3:
+        raise ValueError(
+            f"CRIMP_TPU_DIST={spec!r}: expected "
+            "'coordinator:port,num_processes,process_id'")
+    initialize(coordinator_address=parts[0], num_processes=int(parts[1]),
+               process_id=int(parts[2]))
+    _DIST_STARTED = True
+    import jax
+
+    jax.devices()  # force backend bring-up under the distributed service
+    return process_identity()
 
 
 def process_identity() -> tuple[int, int]:
@@ -147,15 +204,148 @@ def hybrid_mesh(event_parallel_per_slice: int | None = None, devices=None) -> Me
     return Mesh(grid, (EVENT_AXIS, TRIAL_AXIS))
 
 
+def host_device_grid(devices=None) -> np.ndarray:
+    """Global devices as a (process_count, local_per_host) host-major grid.
+
+    Row ``k`` is process ``k``'s addressable devices — the ICI domain a
+    per-host event psum stays inside. Requires a rectangular job (every
+    host contributes the same device count), which is how both pods and
+    the localhost N-process CPU jobs are launched.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    devices = sorted(devices, key=lambda d: (int(getattr(d, "process_index", 0)),
+                                             int(d.id)))
+    counts: dict[int, int] = {}
+    for d in devices:
+        counts[int(getattr(d, "process_index", 0))] = \
+            counts.get(int(getattr(d, "process_index", 0)), 0) + 1
+    per_host = set(counts.values())
+    if len(per_host) > 1:
+        raise ValueError(
+            f"non-rectangular job: per-host device counts {sorted(counts.items())}")
+    return np.asarray(devices).reshape(len(counts), per_host.pop())
+
+
+def global_grid_mesh(devices=None) -> Mesh:
+    """The 2-D (events x trials) mesh of a multi-process job.
+
+    The TRIAL axis spans hosts over DCN (its only traffic is the final
+    per-trial result gather); the EVENT axis is each host's local devices
+    on ICI, so the per-block event psum of the grid kernels never leaves
+    a host. Existing sharded twins (``z2_sharded`` & co.) dispatch on
+    this mesh unchanged — the axis names are the canonical ones.
+    """
+    grid = host_device_grid(devices)
+    return Mesh(grid.T, (EVENT_AXIS, TRIAL_AXIS))
+
+
+def global_source_mesh(devices=None) -> Mesh:
+    """The 1-D source mesh of a multi-process job: sources data-parallel
+    over every device of every host, host-major — so each host's source
+    rows are a contiguous block it can load without ever materializing
+    the global batch (see :func:`process_local_rows` / :func:`global_array`)."""
+    grid = host_device_grid(devices)
+    return Mesh(grid.reshape(-1), (SOURCE_AXIS,))
+
+
+def process_local_rows(n_rows: int) -> tuple[int, int]:
+    """This process's ``[lo, hi)`` block of a host-major leading axis.
+
+    ``n_rows`` must divide evenly across processes (callers pad to the
+    global device count first, which is a multiple of the host count)."""
+    idx, count = process_identity()
+    if n_rows % count:
+        raise ValueError(f"{n_rows} rows do not tile across {count} processes")
+    per = n_rows // count
+    return idx * per, (idx + 1) * per
+
+
+def global_array(local_rows, mesh: Mesh, spec, global_shape=None):
+    """Process-local -> global bridge for host-sharded leading-axis data.
+
+    Each host hands in ONLY its own row block (``process_local_rows`` of
+    the global batch) and gets back the global jax.Array laid out by
+    ``spec`` on ``mesh`` — ``jax.make_array_from_process_local_data``
+    stitches the per-host shards without any host ever holding the whole
+    batch. Single-process jobs degrade to a plain ``device_put``.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    local_rows = np.asarray(local_rows)
+    sharding = NamedSharding(mesh, spec)
+    _, count = process_identity()
+    if count <= 1:
+        return jax.device_put(local_rows, sharding)
+    if global_shape is None:
+        global_shape = (local_rows.shape[0] * count,) + local_rows.shape[1:]
+    return jax.make_array_from_process_local_data(
+        sharding, local_rows, tuple(global_shape))
+
+
+def replicated_array(full, mesh: Mesh, spec):
+    """Place host-replicated data (events, scalars) onto a global mesh.
+
+    Every process holds the full host-side array (the event axis stays
+    within a host, so event-sharded inputs are replicated ACROSS hosts);
+    the callback form hands each addressable device exactly its shard.
+    Single-process jobs degrade to a plain ``device_put``.
+    """
+    import jax
+    from jax.sharding import NamedSharding
+
+    full = np.asarray(full)
+    sharding = NamedSharding(mesh, spec)
+    _, count = process_identity()
+    if count <= 1:
+        return jax.device_put(full, sharding)
+    return jax.make_array_from_callback(full.shape, sharding,
+                                        lambda idx: full[idx])
+
+
+def fetch_global(arr) -> np.ndarray:
+    """Materialize a (possibly cross-host) jax.Array on every host.
+
+    The multi-process twin of ``np.asarray(out)``: single-process arrays
+    convert directly; arrays spanning processes go through one tiled
+    ``process_allgather`` (the trial/source axis's only DCN traffic —
+    the final result gather the mesh layout was chosen around).
+    """
+    _, count = process_identity()
+    if count <= 1 or getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+def spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh's devices live on more than one process."""
+    procs = {int(getattr(d, "process_index", 0))
+             for d in np.asarray(mesh.devices).ravel()}
+    return len(procs) > 1
+
+
 def auto_global_mesh(min_devices: int = 2) -> Mesh | None:
     """Best global mesh for this process's device view, or None below
-    ``min_devices``: hybrid across slices when the job is multi-slice,
+    ``min_devices``: the host-major 2-D mesh when the job is
+    multi-process (trials across hosts over DCN, events on each host's
+    local devices), hybrid across slices when the job is multi-slice,
     else the ICI-topology-aware single-slice mesh."""
     import jax
 
     devices = jax.devices()
     if len(devices) < min_devices:
         return None
+    _, count = process_identity()
+    if count > 1:
+        try:
+            return global_grid_mesh(devices)
+        except ValueError:
+            pass  # non-rectangular job: fall through to the 1-D layouts
     try:
         return hybrid_mesh(devices=devices)
     except ValueError:
@@ -164,9 +354,18 @@ def auto_global_mesh(min_devices: int = 2) -> Mesh | None:
 
 __all__ = [
     "initialize",
+    "ensure_distributed",
     "process_identity",
     "topology_mesh",
     "hybrid_mesh",
+    "host_device_grid",
+    "global_grid_mesh",
+    "global_source_mesh",
+    "process_local_rows",
+    "global_array",
+    "replicated_array",
+    "fetch_global",
+    "spans_processes",
     "auto_global_mesh",
     "build_mesh",
 ]

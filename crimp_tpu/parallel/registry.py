@@ -175,7 +175,8 @@ class KernelSharding:
             return REPLICATED
         raise KeyError(
             f"sharding registry: kernel rule {self.rule.kernel!r} has no "
-            f"spec for param {param!r} (add a row or pass a scalar leaf)")
+            f"spec for param {param!r} on mesh {dict(self.mesh.shape)} "
+            f"(add a row or pass a scalar leaf)")
 
     def in_specs(self, *names: str) -> tuple[P, ...]:
         return tuple(self.spec(n) for n in names)
@@ -202,8 +203,40 @@ class KernelSharding:
         return int(math.prod(
             int(self.mesh.shape[a]) for a in self.rule.reduce_axes)) or 1
 
+    def dcn_axes(self) -> tuple[str, ...]:
+        """Mesh axes whose devices span more than one process.
+
+        On the host-major 2-D global mesh this is the trial/source axis —
+        the DCN leg — while the event axis stays within a host (ICI).
+        Duck-typed over ``mesh.devices`` so stub-device meshes (tests)
+        and real multi-process meshes both classify."""
+        devs = np.asarray(self.mesh.devices)
+        names = tuple(self.mesh.axis_names)
+        out = []
+        for ax, name in enumerate(names):
+            moved = np.moveaxis(devs, ax, 0).reshape(devs.shape[ax], -1)
+            for col in range(moved.shape[1]):
+                procs = {int(getattr(d, "process_index", 0))
+                         for d in moved[:, col]}
+                if len(procs) > 1:
+                    out.append(name)
+                    break
+        return tuple(out)
+
+    def _reduced_buffer_bytes(self, out_info) -> float:
+        """Per-shard reduced-buffer size B of the kernel's psum (the sum
+        over outputs of global bytes / out-spec mesh extent)."""
+        total = 0.0
+        for sds, out_spec in zip(out_info, self.rule.outs):
+            nbytes = (math.prod(int(d) for d in sds.shape)
+                      * np.dtype(sds.dtype).itemsize)
+            shards = math.prod(_mesh_axis_size(self.mesh, ax)
+                               for ax in out_spec) or 1
+            total += nbytes / shards
+        return total
+
     def collective_bytes(self, out_info) -> float:
-        """Estimated PER-DEVICE bytes the kernel's psum moves over ICI.
+        """Estimated PER-DEVICE bytes the kernel's psum moves (both legs).
 
         Ring all-reduce over ``k`` devices moves ``2*(k-1)/k * B`` bytes
         per device, where ``B`` is the per-shard reduced-buffer size —
@@ -212,17 +245,30 @@ class KernelSharding:
         ``.shape``/``.dtype`` (ShapeDtypeStructs or arrays), one per
         kernel output, in ``outs`` order. 0.0 when the rule reduces over
         nothing or one device."""
-        k = self.reduce_size()
-        if k <= 1:
-            return 0.0
-        total = 0.0
-        for sds, out_spec in zip(out_info, self.rule.outs):
-            nbytes = (math.prod(int(d) for d in sds.shape)
-                      * np.dtype(sds.dtype).itemsize)
-            shards = math.prod(_mesh_axis_size(self.mesh, ax)
-                               for ax in out_spec) or 1
-            total += nbytes / shards
-        return 2.0 * (k - 1) / k * total
+        split = self.collective_bytes_split(out_info)
+        return split["ici"] + split["dcn"]
+
+    def collective_bytes_split(self, out_info) -> dict[str, float]:
+        """The psum's per-device byte estimate split into ICI vs DCN legs.
+
+        Each reduce axis contributes its own ring leg over ``k_axis``
+        devices: axes confined to one process ride ICI, axes spanning
+        processes ride DCN. On the host-major global mesh the event psum
+        therefore lands entirely on the ICI leg (it never leaves a host)
+        and only a reduction spanning hosts would put bytes on DCN —
+        which is exactly the layout contract ``obs roofline`` verifies."""
+        out = {"ici": 0.0, "dcn": 0.0}
+        if self.reduce_size() <= 1:
+            return out
+        buf = self._reduced_buffer_bytes(out_info)
+        dcn = set(self.dcn_axes())
+        for axis in self.rule.reduce_axes:
+            k = _mesh_axis_size(self.mesh, axis)
+            if k <= 1:
+                continue
+            leg = "dcn" if axis in dcn else "ici"
+            out[leg] += 2.0 * (k - 1) / k * buf
+        return out
 
 
 def specs_for(kernel: str, mesh: Mesh) -> KernelSharding:

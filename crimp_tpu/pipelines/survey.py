@@ -277,12 +277,27 @@ def survey_measure_toas(specs, phShiftRes: int = 1000, nbrBins: int = 15,
     Flight-recorded as an obs run with ``sources_batched`` /
     ``bucket_count`` / ``bucket_occupancy_pct`` telemetry and an
     ``obs.beat(label="sources")`` per-bucket heartbeat.
+
+    Multi-host contract: bucket assignment is a pure function of the spec
+    list — grouping keys, bucket widths and bucket membership never
+    consult ``process_index`` — so on a multi-process job every host
+    walks the identical bucket sequence and compiles the identical SPMD
+    program (the batched dispatches inside ``compute_bucket`` shard the
+    source axis across hosts through the global source mesh). Only the
+    per-source FALLBACK ladder is host-partitioned: a demoted source is
+    retried by exactly the host that owns its index, so one host's
+    failure domain never serializes the others (frames for sources owned
+    by other hosts stay ``None`` locally; ``last_survey_info`` carries
+    the ``process_index``/``process_count`` stamps to merge on).
     """
     with obs.run("survey_measure_toas"):
         return _survey_impl(list(specs), phShiftRes, nbrBins, varyAmps)
 
 
 def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
+    from crimp_tpu.parallel import multihost
+
+    pidx, pcount = multihost.process_identity()
     global _last_info
     n_total = len(specs)
     frames: list[pd.DataFrame | None] = [None] * n_total
@@ -374,7 +389,11 @@ def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
         obs.beat(done, n_total, label="sources")
 
     n_batched = sum(1 for f in frames if f is not None)
-    for i in sorted(fallback):
+    # per-host failure domain: on a multi-process job each demoted source
+    # is retried by exactly one host (deterministic index ownership), so a
+    # local fallback never serializes the whole fleet behind one host
+    owned = [i for i in sorted(fallback) if i % pcount == pidx]
+    for i in owned:
         try:
             frames[i] = measure_source_toas(
                 specs[i], phShiftRes, nbrBins, varyAmps,
@@ -405,6 +424,8 @@ def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
     _last_info = {
         "n_sources": n_total,
         "n_batched": n_batched,
+        "process_index": pidx,
+        "process_count": pcount,
         "n_fallback": len(fallback),
         "n_failed": sum(1 for f in frames if f is None),
         "bucket_count": len(buckets),

@@ -95,7 +95,7 @@ class ServingEngine:
                  scheduler: scheduler_mod.DeadlineScheduler | None = None,
                  breakers: breaker_mod.RungBreakers | None = None,
                  phShiftRes: int = 1000, nbrBins: int = 15,
-                 varyAmps: bool = False):
+                 varyAmps: bool = False, mesh=None):
         self.queue = queue if queue is not None else AdmissionQueue()
         self.scheduler = scheduler if scheduler is not None \
             else scheduler_mod.DeadlineScheduler()
@@ -108,6 +108,33 @@ class ServingEngine:
         self._warm: set[str] = set()  # clients with a seeded fold product
         self.counts = {"ok": 0, "degraded": 0, "error": 0,
                        "deadline_miss": 0, "steps": 0}
+        # capacity note: the (optionally global, multi-host) mesh the
+        # engine serves on — informational for stats()/bench_serving; the
+        # dispatch paths keep routing through the multisource engine's own
+        # mesh selection, so passing a mesh never changes results
+        self.mesh = mesh
+        self.capacity = self._capacity_note(mesh)
+
+    @staticmethod
+    def _capacity_note(mesh) -> dict:
+        """Describe the serving capacity: device count, mesh axes, and the
+        process (host) topology — so a multi-host deployment's stats say
+        which fraction of the fleet this engine instance fronts."""
+        try:
+            from crimp_tpu.parallel import multihost
+            pidx, pcount = multihost.process_identity()
+        except Exception:  # noqa: BLE001 — capacity note is telemetry only  # graftlint: disable=GL006 (telemetry guard: the capacity note must never block engine construction)
+            pidx, pcount = 0, 1
+        note = {"process_index": pidx, "process_count": pcount,
+                "devices": None, "mesh_axes": None}
+        if mesh is not None:
+            try:
+                note["devices"] = int(mesh.devices.size)
+                note["mesh_axes"] = {str(a): int(mesh.shape[a])
+                                     for a in mesh.axis_names}
+            except Exception:  # noqa: BLE001 — duck-typed mesh  # graftlint: disable=GL006 (telemetry guard: an exotic mesh object degrades to a partial note)
+                pass
+        return note
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -411,6 +438,7 @@ class ServingEngine:
             "warm_clients": len(self._warm),
             "breakers": self.breakers.snapshot(),
             "rung_latency_est_s": self.scheduler.estimates(),
+            "capacity": dict(self.capacity),
         }
 
 

@@ -11,6 +11,9 @@
 #   bash scripts/obs_report.sh validate obs_runs/<run>.json
 #   bash scripts/obs_report.sh tail     obs_runs [--once]
 #   bash scripts/obs_report.sh salvage  obs_runs/<run>.events.jsonl
+#   bash scripts/obs_report.sh merge    obs_runs              # newest run's
+#       host<k> streams, auto-discovered by shared run_id
+#   bash scripts/obs_report.sh merge    obs_runs --run-id <id-substring>
 #   bash scripts/obs_report.sh ledger   check BENCH_r*.json \
 #       --fail-on-regression --tolerance-pct 5
 #

@@ -357,6 +357,28 @@ class TestGL003:
         assert any("CRIMP_TPU_GRID3D" in m and "unregistered" in m
                    for m in msgs)
 
+    def test_unregistered_distributed_init_read_fires(self, tmp_path):
+        """Multi-host bring-up is governed by the single registered
+        CRIMP_TPU_DIST knob ("coordinator:port,num_processes,process_id").
+        A side-channel read such as CRIMP_TPU_DIST_COORD — splitting the
+        coordinator address into its own undeclared variable — must turn
+        the gate red rather than fork the launch contract."""
+        assert "CRIMP_TPU_DIST" in knobs.REGISTRY  # the real registry
+        assert "CRIMP_TPU_DIST_COORD" not in knobs.REGISTRY
+        rep = run_tree(tmp_path, {"pkg/dist.py": """
+            import os
+
+            COORD = os.environ.get("CRIMP_TPU_DIST_COORD", "localhost:0")
+        """}, rules=("GL003",), registry=dict(knobs.REGISTRY),
+            tools_md_text="\n".join(
+                f"| `{k}` | x | x |" for k in knobs.REGISTRY),
+            numeric_keys=tuple(
+                k.numeric_key for k in knobs.REGISTRY.values()
+                if k.numeric_key))
+        msgs = [f.message for f in rep.unwaived]
+        assert any("CRIMP_TPU_DIST_COORD" in m and "unregistered" in m
+                   for m in msgs)
+
 
 class TestGL003AgainstRepo:
     """The removal tests the issue pins: deleting a knob's docs row or its

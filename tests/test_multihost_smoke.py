@@ -1,0 +1,175 @@
+"""Multi-host dispatch smoke: 1/2/4-process localhost bitwise parity.
+
+The tentpole contract of the multi-host layer (docs/parity.md): moving
+the trial/source axis across processes must not change a single bit of
+the science outputs, because the host axis never carries a reduction —
+the per-block event psum stays on each host's local devices (fixed at 2
+virtual CPU devices per process here, so the reduction grouping is
+identical at every process count), the fold is elementwise per source
+row, the segment-batched fits run host-local at equal padded widths, and
+the general grid kernel shards the literal frequency array.
+
+Each configuration runs as REAL subprocess workers joined through
+``jax.distributed`` (gloo collectives on CPU, brought up by the
+``CRIMP_TPU_DIST`` knob) — including the 1-process baseline, so every
+configuration pays identical bring-up. The 2-process smoke is tier-1;
+the 4-process matrix rides the slow tier. Jobs are time-bounded and
+skip (not fail) when this host is too slow to finish them — the parity
+assertions themselves must never be weakened to absorb a slow box.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# One worker program, identical for every process count: deterministic
+# seeds, fixed workload sizes. Process 0 prints one JSON line of hashes.
+_WORKER = """
+import hashlib
+import json
+
+import numpy as np
+
+from crimp_tpu.parallel import multihost
+
+pidx, pcount = multihost.ensure_distributed()
+
+import jax
+import jax.numpy as jnp
+
+from crimp_tpu.models import profiles, timing
+from crimp_tpu.ops import multisource, toafit
+from crimp_tpu.parallel import mesh as pmesh
+
+
+def sha(tree):
+    h = hashlib.sha1()
+    for leaf in jax.tree_util.tree_leaves(tree):
+        h.update(np.ascontiguousarray(
+            np.asarray(leaf, dtype=np.float64)).tobytes())
+    return h.hexdigest()
+
+
+# fold rows: source axis spans hosts on the global source mesh
+rng = np.random.RandomState(13)
+edges = np.linspace(58000.0, 58004.0, 3)
+tms, seg_lists = [], []
+for i in range(8):
+    tms.append(timing.from_dict({"PEPOCH": 58000.0, "F0": 0.1 + 0.002 * i,
+                                 "F1": -1e-13}))
+    seg_lists.append([np.sort(rng.uniform(lo + 1e-6, hi - 1e-6, 60))
+                      for lo, hi in zip(edges[:-1], edges[1:])])
+fold_hash = sha(multisource.fold_sources(tms, seg_lists))
+
+# fit columns: segment-batched ToA fit, host-local under multiprocess
+tpl = profiles.ProfileParams(
+    norm=jnp.asarray(10.0), amp=jnp.asarray([3.0]), loc=jnp.asarray([0.3]),
+    wid=jnp.zeros(1), ph_shift=jnp.asarray(0.0), amp_shift=jnp.asarray(1.0))
+phases = np.mod(rng.vonmises(0.0, 2.0, (4, 128)) / (2 * np.pi) + 0.3, 1.0)
+masks = np.ones_like(phases, dtype=bool)
+exposures = np.full(4, 128 / 10.0)
+cfg = toafit.ToAFitConfig(ph_shift_res=50, n_brute=8, refine_iters=3)
+fit = toafit.fit_toas_batch_auto("fourier", tpl, phases, masks, exposures,
+                                 cfg)
+fit_hash = sha({k: fit[k] for k in sorted(fit)})
+
+# grid: trials span hosts on the 2-D global mesh; the GENERAL kernel
+# shards the literal frequency array (the fastpath re-derives shard
+# frequencies from axis_index, which is only argmax-stable)
+t_ev = np.sort(np.random.RandomState(7).uniform(0.0, 20.0, 512)) * 86400.0
+freqs = np.linspace(0.1430, 0.1436, 16)
+fdots = np.array([-2e-14, -1e-14])
+grid = np.asarray(pmesh.z2_2d_sharded(t_ev, freqs, fdots,
+                                      use_fastpath=False))
+
+if pidx == 0:
+    print(json.dumps({
+        "pcount": pcount,
+        "ndev": len(jax.devices()),
+        "fold": fold_hash,
+        "fit": fit_hash,
+        "grid": hashlib.sha1(
+            np.ascontiguousarray(grid).tobytes()).hexdigest(),
+        "argmax": int(np.argmax(grid)),
+    }), flush=True)
+"""
+
+_JOB_CACHE: dict[int, dict] = {}
+
+
+def _run_job(nproc: int, timeout_s: float = 300.0) -> dict:
+    """Launch an nproc-worker localhost job; return process 0's record."""
+    if nproc in _JOB_CACHE:
+        return _JOB_CACHE[nproc]
+    with socket.socket() as s:  # a free coordinator port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    base_env = dict(os.environ)
+    base_env["JAX_PLATFORMS"] = "cpu"
+    # a FIXED per-process device count keeps the event-psum grouping
+    # identical at every process count (the parity precondition)
+    base_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    # pin the grid blocking: a tuner winner differing between configs
+    # would change the reduction tiling
+    base_env["CRIMP_TPU_GRID_BLOCKS"] = "64,4"
+    procs = []
+    for k in range(nproc):
+        env = dict(base_env)
+        env["CRIMP_TPU_DIST"] = f"localhost:{port},{nproc},{k}"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER],
+            stdout=subprocess.PIPE if k == 0 else subprocess.DEVNULL,
+            stderr=subprocess.PIPE if k == 0 else subprocess.DEVNULL,
+            env=env, cwd=ROOT))
+    try:
+        out, err = procs[0].communicate(timeout=timeout_s)
+        for p in procs[1:]:
+            p.wait(timeout=60.0)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip(f"{nproc}-process localhost job exceeded {timeout_s:g}s "
+                    "on this host")
+    rcs = [p.returncode for p in procs]
+    assert not any(rcs), (
+        f"worker rcs {rcs}; rank-0 stderr tail: "
+        f"{(err or b'').decode(errors='replace')[-2000:]}")
+    doc = None
+    for line in (out or b"").decode(errors="replace").splitlines():
+        if line.strip().startswith("{"):
+            doc = json.loads(line)
+    assert isinstance(doc, dict), "rank 0 printed no JSON record"
+    _JOB_CACHE[nproc] = doc
+    return doc
+
+
+def _assert_bitwise(ref: dict, cand: dict) -> None:
+    assert cand["fold"] == ref["fold"], "fold rows diverged across hosts"
+    assert cand["fit"] == ref["fit"], "fit columns diverged across hosts"
+    assert cand["grid"] == ref["grid"], "grid array diverged across hosts"
+    assert cand["argmax"] == ref["argmax"]
+
+
+@pytest.mark.multiproc
+def test_two_process_bitwise_vs_single():
+    ref = _run_job(1)
+    two = _run_job(2)
+    assert ref["pcount"] == 1 and ref["ndev"] == 2
+    assert two["pcount"] == 2 and two["ndev"] == 4, \
+        "distributed bring-up did not produce the global device view"
+    _assert_bitwise(ref, two)
+
+
+@pytest.mark.multiproc
+@pytest.mark.slow
+def test_four_process_bitwise_vs_single():
+    ref = _run_job(1)
+    four = _run_job(4)
+    assert four["pcount"] == 4 and four["ndev"] == 8
+    _assert_bitwise(ref, four)

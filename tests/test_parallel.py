@@ -306,6 +306,148 @@ class TestMultihostMeshes:
         mesh = pmesh.auto_mesh()
         assert calls and mesh is not None
 
+    def test_hybrid_mesh_multislice_layout(self, monkeypatch):
+        """Fake a 4-slice job (CPU devices wrapped with slice_index stubs):
+        the DCN axis must land on TRIALS — dcn_mesh_shape=(1, n_slices) —
+        with each slice's devices forming one intact event column."""
+        from types import SimpleNamespace
+
+        from jax.experimental import mesh_utils
+
+        from crimp_tpu.parallel import multihost
+
+        real = jax.devices()[:8]
+        stubs = [SimpleNamespace(device=d, slice_index=i // 2, id=d.id,
+                                 process_index=0)
+                 for i, d in enumerate(real)]
+        seen: dict = {}
+
+        def fake_hybrid(mesh_shape, dcn_mesh_shape, devices):
+            seen["mesh_shape"] = tuple(mesh_shape)
+            seen["dcn_mesh_shape"] = tuple(dcn_mesh_shape)
+            # lay slices out as the real builder would: events within a
+            # slice, slices along the trial axis (real devices, so Mesh
+            # construction is valid)
+            cols = [[s.device for s in stubs if s.slice_index == k]
+                    for k in range(4)]
+            return np.asarray(cols, dtype=object).T  # (events=2, trials=4)
+
+        monkeypatch.setattr(mesh_utils, "create_hybrid_device_mesh",
+                            fake_hybrid)
+        mesh = multihost.hybrid_mesh(devices=stubs)
+        assert dict(mesh.shape) == {"events": 2, "trials": 4}
+        assert seen["mesh_shape"] == (2, 1)
+        assert seen["dcn_mesh_shape"] == (1, 4), \
+            "the DCN axis must carry trials, never the event psum"
+        grid = np.asarray(mesh.devices)
+        by_slice = {s.device: s.slice_index for s in stubs}
+        for t in range(grid.shape[1]):
+            assert len({by_slice[d] for d in grid[:, t]}) == 1, \
+                "an event column (one psum group) crossed a slice boundary"
+
+    def test_hybrid_mesh_nonuniform_tiling_raises(self):
+        from types import SimpleNamespace
+
+        from crimp_tpu.parallel import multihost
+
+        stubs = [SimpleNamespace(slice_index=i // 3, id=i, process_index=0)
+                 for i in range(6)]  # 3 devices per slice
+        with pytest.raises(ValueError, match="do not tile"):
+            multihost.hybrid_mesh(devices=stubs,
+                                  event_parallel_per_slice=2)
+
+    def test_auto_global_mesh_value_error_fallback(self, monkeypatch):
+        """A multi-process identity whose job turns out non-rectangular
+        (host_device_grid raises) must fall through the ladder to the
+        single-slice topology mesh, never crash dispatch."""
+        from crimp_tpu.parallel import multihost
+
+        monkeypatch.setattr(multihost, "process_identity", lambda: (0, 2))
+
+        def bad_grid(devices=None):
+            raise ValueError("non-rectangular job: per-host device counts")
+
+        monkeypatch.setattr(multihost, "host_device_grid", bad_grid)
+        mesh = multihost.auto_global_mesh()
+        assert mesh is not None
+        assert dict(mesh.shape)["events"] == len(jax.devices())
+
+    def test_auto_global_mesh_prefers_global_grid_when_multiprocess(
+            self, monkeypatch):
+        from crimp_tpu.parallel import multihost
+
+        monkeypatch.setattr(multihost, "process_identity", lambda: (0, 2))
+        grid = np.asarray(jax.devices()[:8]).reshape(2, 4)  # 2 "hosts" x 4
+        monkeypatch.setattr(multihost, "host_device_grid",
+                            lambda devices=None: grid)
+        mesh = multihost.auto_global_mesh()
+        # host-major transpose: events = the per-host devices, trials =
+        # the host axis
+        assert dict(mesh.shape) == {"events": 4, "trials": 2}
+        got = np.asarray(mesh.devices)
+        np.testing.assert_array_equal(got, grid.T)
+
+
+class TestRegistryDcnAccounting:
+    """collective_bytes split into ICI vs DCN legs (parallel/registry.py),
+    on duck-typed stub meshes so no real multi-process job is needed."""
+
+    @staticmethod
+    def _stub_mesh(trials_span_processes: bool):
+        from types import SimpleNamespace
+
+        def dev(proc):
+            return SimpleNamespace(process_index=proc)
+
+        # (events=2, trials=2) grid; process index varies along exactly
+        # one axis
+        if trials_span_processes:
+            devices = np.array([[dev(0), dev(1)], [dev(0), dev(1)]])
+        else:
+            devices = np.array([[dev(0), dev(0)], [dev(1), dev(1)]])
+        return SimpleNamespace(shape={"events": 2, "trials": 2},
+                               axis_names=("events", "trials"),
+                               devices=devices)
+
+    @staticmethod
+    def _outs():
+        from types import SimpleNamespace
+
+        # two (nharm, 1, n_freq) f64 outputs like the grid kernel's
+        return [SimpleNamespace(shape=(2, 1, 8), dtype=np.float64),
+                SimpleNamespace(shape=(2, 1, 8), dtype=np.float64)]
+
+    def test_event_psum_rides_ici_on_host_major_mesh(self):
+        plan = registry.specs_for("sharded_sums_grid",
+                                  self._stub_mesh(trials_span_processes=True))
+        assert plan.dcn_axes() == ("trials",)
+        split = plan.collective_bytes_split(self._outs())
+        # per-out: 2*1*8 f64 = 128 B over 2 trial shards -> B = 64 each;
+        # ring leg over k=2 event devices: 2*(2-1)/2 * 128 = 128
+        assert split == {"ici": 128.0, "dcn": 0.0}
+        assert plan.collective_bytes(self._outs()) == 128.0
+
+    def test_reduction_spanning_hosts_lands_on_dcn(self):
+        plan = registry.specs_for("sharded_sums_grid",
+                                  self._stub_mesh(trials_span_processes=False))
+        # here the EVENT axis spans processes -> the psum's bytes are DCN
+        assert plan.dcn_axes() == ("events",)
+        split = plan.collective_bytes_split(self._outs())
+        assert split == {"ici": 0.0, "dcn": 128.0}
+
+    def test_single_process_mesh_has_no_dcn_axes(self):
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=4)
+        plan = registry.specs_for("sharded_sums_grid", mesh)
+        assert plan.dcn_axes() == ()
+        split = plan.collective_bytes_split(self._outs())
+        assert split["dcn"] == 0.0 and split["ici"] > 0.0
+
+    def test_spec_keyerror_names_mesh_shape(self):
+        mesh = pmesh.build_mesh(jax.devices()[:8], event_parallel=4)
+        plan = registry.specs_for("sharded_sums_grid", mesh)
+        with pytest.raises(KeyError, match=r"'events': 4"):
+            plan.spec("no_such_param")
+
 
 class TestDryrun:
     def test_driver_dryrun_8(self):
