@@ -1189,7 +1189,8 @@ def bench_multisource(batch_sizes=(16, 64, 128), n_int: int = 4,
 def bench_serving(rates_hz=(2.0, 4.0, 8.0), n_clients: int = 6,
                   rounds_per_rate: int = 3, events_per_int: int = 100,
                   n_int: int = 2, phShiftRes: int = 200,
-                  deadline_s: float | None = None, seed: int = 5) -> dict:
+                  deadline_s: float | None = None, seed: int = 5,
+                  warm_clients: int = 16, warm_rounds: int = 4) -> dict:
     """Serving-engine throughput/latency under open-loop Poisson load.
 
     ``n_clients`` synthetic pulsars are registered once (cold, batched —
@@ -1204,11 +1205,21 @@ def bench_serving(rates_hz=(2.0, 4.0, 8.0), n_clients: int = 6,
 
     Open-loop: arrivals are scheduled up front; latency includes queue
     wait (coordinated omission is the failure mode this avoids).
+
+    The WARM-HEAVY phase (``warm_clients`` resident clients re-timing for
+    ``warm_rounds`` rounds; 0 skips) A/Bs the stacked warm-refold path
+    (``warm_batch=1``: every warm client refolds in one
+    ``delta_refold_batch`` dispatch per round) against the per-request
+    loop (``warm_batch=0``), gates the promotion on speedup > 1.5x at
+    >=16 clients, batched p99 no worse, and per-ToA bitwise frame parity,
+    records the ledger-gated ``warm_requests_per_s``, and persists the
+    verdict through ``autotune.store_serve_warm_batch`` so later serving
+    rounds resolve it from the cache.
     """
     import pandas as pd
 
     from crimp_tpu import obs, serve
-    from crimp_tpu.ops import deltafold
+    from crimp_tpu.ops import autotune, deltafold
     from crimp_tpu.pipelines import survey
 
     rng = np.random.RandomState(seed)
@@ -1298,6 +1309,99 @@ def bench_serving(rates_hz=(2.0, 4.0, 8.0), n_clients: int = 6,
         f"{out['steady_state_on_delta_path']} "
         f"(refolds +{out['delta_fold_refolds']:.0f}, exact "
         f"+{out['delta_fold_exact_folds']:.0f})")
+
+    # -- warm-heavy phase: A/B the stacked warm-refold dispatch -------------
+    if warm_clients > 0 and warm_rounds > 0:
+        wrng = np.random.RandomState(seed + 1000)
+        wclients = []
+        for i in range(warm_clients):
+            times = np.sort(np.concatenate([
+                wrng.uniform(lo + 1e-6, hi - 1e-6, events_per_int)
+                for lo, hi in zip(edges[:-1], edges[1:])]))
+            wclients.append({"name": f"warm{i:03d}", "times": times,
+                             "f0": 0.11 + 0.0029 * (i % 59)})
+
+        def warm_arm(pin):
+            # each arm gets a fresh engine AND a fresh fold cache, so the
+            # two arms pay identical (untimed) cold registrations and the
+            # timed rounds compare nothing but the warm dispatch shape
+            deltafold.clear_cache()
+            eng = serve.ServingEngine(phShiftRes=phShiftRes, warm_batch=pin)
+            for c in wclients:
+                eng.submit(spec_for(c, 0))
+            errors = sum(1 for r in eng.drain_all() if r.status == "error")
+            lat_ms: list = []
+            rungs: dict = {}
+            frames: dict = {}
+            t0 = time.perf_counter()
+            for rn in range(1, warm_rounds + 1):
+                for c in wclients:
+                    eng.submit(spec_for(c, rn))
+                for r in eng.drain_all():
+                    lat_ms.append(1e3 * (r.latency_s or 0.0))
+                    rungs[r.rung] = rungs.get(r.rung, 0) + 1
+                    frames[(rn, r.client_id)] = r.frame
+                    errors += r.status == "error"
+            wall = time.perf_counter() - t0
+            n_req = warm_clients * warm_rounds
+            return {
+                "warm_requests_per_s": n_req / wall if wall > 0 else 0.0,
+                "p50_latency_ms": float(np.percentile(lat_ms, 50)),
+                "p99_latency_ms": float(np.percentile(lat_ms, 99)),
+                "errors": int(errors), "rungs": rungs,
+            }, frames
+
+        def frames_match(fa, fb):
+            if fa.keys() != fb.keys():
+                return False
+            for k in fa:
+                if fa[k] is None or fb[k] is None:
+                    return False
+                try:
+                    pd.testing.assert_frame_equal(fa[k], fb[k],
+                                                  check_exact=True)
+                except AssertionError:
+                    return False
+            return True
+
+        solo, solo_frames = warm_arm(0)
+        batched, batched_frames = warm_arm(1)
+        speedup = batched["warm_requests_per_s"] / max(
+            solo["warm_requests_per_s"], 1e-12)
+        bitwise = frames_match(solo_frames, batched_frames)
+        out["warm"] = {
+            "clients": warm_clients, "rounds": warm_rounds,
+            "solo": solo, "batched": batched,
+            "speedup": speedup, "bitwise_match": bitwise,
+            # the promotion gates from docs/performance.md: throughput,
+            # tail latency, and exactness must all clear
+            "gate_speedup_1p5": bool(speedup > 1.5
+                                     and warm_clients >= 16),
+            "gate_p99_no_worse": bool(
+                batched["p99_latency_ms"] <= solo["p99_latency_ms"]),
+        }
+        # ledger-gated headline: the batched arm's steady-state throughput
+        out["warm_requests_per_s"] = batched["warm_requests_per_s"]
+        log(f"[bench] serving warm A/B ({warm_clients} clients x "
+            f"{warm_rounds} rounds): batched "
+            f"{batched['warm_requests_per_s']:.2f} req/s vs solo "
+            f"{solo['warm_requests_per_s']:.2f} req/s "
+            f"({speedup:.2f}x, bitwise={bitwise}, "
+            f"p99 {batched['p99_latency_ms']:.1f} vs "
+            f"{solo['p99_latency_ms']:.1f} ms)")
+        # persist the A/B verdict so resolve_serve_warm_batch's cache
+        # tier sees it on the next serving process (env still overrides)
+        verdict = 1 if (bitwise and speedup > 1.0) else 0
+        try:
+            autotune.store_serve_warm_batch(
+                warm_clients, events_per_int,
+                {"serve_warm_batch": verdict, "speedup": speedup,
+                 "bitwise_match": bitwise})
+            out["warm"]["verdict_stored"] = verdict
+        except Exception as exc:  # noqa: BLE001 — verdict persistence is
+            # advisory; a read-only cache dir must not fail the bench
+            log(f"[bench] serving warm verdict store failed: {exc}")
+            out["warm"]["verdict_stored"] = None
     return out
 
 
@@ -1321,6 +1425,11 @@ def serving_main(argv=None) -> int:
     ap.add_argument("--rounds-per-rate", type=int, default=3)
     ap.add_argument("--events-per-int", type=int, default=100)
     ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--warm-clients", type=int, default=16,
+                    help="resident clients in the warm-heavy A/B phase "
+                         "(0 skips the phase)")
+    ap.add_argument("--warm-rounds", type=int, default=4,
+                    help="timed re-timing rounds per warm A/B arm")
     args = ap.parse_args(argv)
     rates = tuple(float(r) for r in args.rates.split(",") if r.strip())
     if len(rates) < 3:
@@ -1339,7 +1448,8 @@ def serving_main(argv=None) -> int:
             rounds_per_rate=args.rounds_per_rate,
             events_per_int=args.events_per_int,
             deadline_s=None if args.deadline_ms is None
-            else args.deadline_ms / 1000.0)
+            else args.deadline_ms / 1000.0,
+            warm_clients=args.warm_clients, warm_rounds=args.warm_rounds)
     record = {
         "metric": "serving_throughput",
         "unit": "req/s",
@@ -1350,6 +1460,10 @@ def serving_main(argv=None) -> int:
         "p50_latency_ms": res["p50_latency_ms"],
         "p99_latency_ms": res["p99_latency_ms"],
         "steady_state_on_delta_path": res["steady_state_on_delta_path"],
+        **({"warm_requests_per_s": res["warm_requests_per_s"],
+            "warm_speedup": res["warm"]["speedup"],
+            "warm_bitwise_match": res["warm"]["bitwise_match"]}
+           if "warm" in res else {}),
         "serving": res,
         # only this run's manifest; last_manifest_path() can be stale
         # when obs is off but an earlier run recorded one

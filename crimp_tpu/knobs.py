@@ -225,6 +225,18 @@ REGISTRY: dict[str, Knob] = _build_registry((
          consumer="crimp_tpu/serve/breaker.py",
          doc="consecutive classified failures at a ladder rung before its "
              "circuit breaker opens (half-opens on probe); 0 disables"),
+    Knob("CRIMP_TPU_SERVE_WARM_BATCH", "unset (batched warm path on)", "int",
+         consumer="crimp_tpu/serve/engine.py via ops/autotune.py",
+         doc="warm re-timing path: 1 stacks every warm client's delta "
+             "refold into one refold_batch dispatch, 0 pins the "
+             "per-request loop; per-client bits match the solo refold "
+             "either way"),
+    Knob("CRIMP_TPU_SERVE_PREP_OVERLAP", "unset (overlap on)", "bool",
+         consumer="crimp_tpu/serve/engine.py",
+         doc="overlap host-side request prep (longdouble anchoring) with "
+             "the previous round's dispatch on a bounded single-worker "
+             "stage; 0 pins the serial prep order (results bit-identical "
+             "either way)"),
     # -- resilience ---------------------------------------------------------
     Knob("CRIMP_TPU_FAULTS", "unset (injector disarmed)", "str",
          consumer="crimp_tpu/resilience/faultinject.py",

@@ -71,6 +71,10 @@ METRICS: dict[str, dict] = {
                           "better": "lower"},
     "requests_per_s": {"field": "requests_per_s", "better": "higher"},
     "p99_latency_ms": {"field": "p99_latency_ms", "better": "lower"},
+    # steady-state warm re-timing throughput (bench_serving's warm-heavy
+    # phase: >=16 resident clients refolding per round)
+    "warm_requests_per_s": {"field": "warm_requests_per_s",
+                            "better": "higher"},
     # grid-search cube throughput (bench.py bench_jerk): equivalent-coherent
     # cube trials per second, so semi-coherent rounds are comparable to
     # coherent ones at matched coverage

@@ -729,6 +729,78 @@ def resolve_multisource(n_sources: int, n_events: int) -> dict:
     return out
 
 
+# -- serving warm-batch knob ------------------------------------------------
+#
+# CRIMP_TPU_SERVE_WARM_BATCH switches the serving engine's warm re-timing
+# path (serve/engine.py) between the per-request delta-refold loop and the
+# stacked batch: every warm client in a round refolds in ONE
+# deltafold.refold_batch dispatch. Like multisource the batched path is
+# the DEFAULT (per-client bits match the solo refold by construction —
+# docs/serving.md "The warm fast path"), so the cached entry mostly
+# records the measured warm_requests_per_s and lets a failed promotion
+# gate pin the loop (0) on hardware where stacking loses. The cache key
+# uses the kernel name "serve_warm_batch_enable" so the entry can never
+# collide with the other enable entries.
+
+SERVE_WARM_BATCH_ENV = "CRIMP_TPU_SERVE_WARM_BATCH"
+
+
+def serve_warm_batch_defaults() -> dict:
+    return {"serve_warm_batch": 1}
+
+
+def serve_warm_batch_cache_key(n_clients: int, n_events: int,
+                               platform: str | None = None,
+                               device_kind: str | None = None) -> str:
+    return cache_key("serve_warm_batch_enable", False, n_events, n_clients,
+                     platform=platform, device_kind=device_kind)
+
+
+def cached_serve_warm_batch(n_clients: int, n_events: int) -> dict | None:
+    entry = _load_cache().get(serve_warm_batch_cache_key(n_clients, n_events))
+    if not isinstance(entry, dict):
+        return None
+    m = entry.get("serve_warm_batch")
+    if m not in (0, 1):
+        return None
+    return {"serve_warm_batch": m}
+
+
+def store_serve_warm_batch(n_clients: int, n_events: int, entry: dict,
+                           path: pathlib.Path | None = None) -> None:
+    """Persist a gated warm-batch A/B verdict (bench.py calls this)."""
+    _store_entry(serve_warm_batch_cache_key(n_clients, n_events), entry, path)
+
+
+def resolve_serve_warm_batch(n_clients: int, n_events: int) -> dict:
+    """Resolve {serve_warm_batch} for a serving round's warm population.
+
+    Precedence: CRIMP_TPU_SERVE_WARM_BATCH (hard override in both
+    directions, honored even with autotune off; malformed raises) >
+    cached bench A/B verdict (unless CRIMP_TPU_AUTOTUNE=0) > default ON.
+    Never times anything — the A/B with its >1.5x throughput, p99 and
+    bitwise-parity gates lives in bench.py (bench_serving's warm-heavy
+    phase).
+    """
+    out = serve_warm_batch_defaults()
+    env_m = _env_nonneg_int(SERVE_WARM_BATCH_ENV, valid=(0, 1))
+    if autotune_mode() != "off":
+        try:
+            cached = cached_serve_warm_batch(n_clients, n_events)
+        except Exception as exc:  # noqa: BLE001 — a corrupt cache or an
+            # uninitializable backend must never take down a serving round
+            logger.warning("serve_warm_batch autotune cache lookup failed "
+                           "(%s); using static defaults",
+                           resilience.classify(exc).value, exc_info=True)
+            cached = None
+        _count_cache(bool(cached))
+        if cached:
+            out.update(cached)
+    if env_m is not None:
+        out["serve_warm_batch"] = env_m
+    return out
+
+
 # -- timing / tuning --------------------------------------------------------
 
 

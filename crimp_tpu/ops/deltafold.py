@@ -78,7 +78,11 @@ F64_MULT_EPS = 2.0 ** -46
 N_GLITCH_AMP = 5
 
 CACHE_VERSION = 2  # v2: sha256 payload footer detects torn/corrupt writes
-_MEM_CAP = 8
+# In-process LRU slots. Sized for the serving engine's warm population
+# (bench_serving's warm-heavy phase runs >=16 resident clients): a cap
+# below the working set would evict a warm client's product every round
+# and silently turn its delta refolds back into exact folds.
+_MEM_CAP = 64
 
 
 # ---------------------------------------------------------------------------
@@ -516,7 +520,11 @@ def cached_fold(tm, times_cat, sizes, t_ref, delta, anchor_idx, exact_fn,
     mode, disk_dir = fold_cache_mode()
     pvec = linear_param_vector(tm)
     nonlin = nonlinear_sha(tm)
-    info: dict = {"mode": "exact", "n_events": int(np.size(times_cat))}
+    # "stored"/"tag" let callers (the serving engine's warmth tracking)
+    # confirm THIS call left a product in the cache under THEIR tag — a
+    # client whose seed never landed must stay cold.
+    info: dict = {"mode": "exact", "n_events": int(np.size(times_cat)),
+                  "tag": tag, "stored": mode != "off"}
     key = None
     prod = None
     if mode != "off":
@@ -580,3 +588,138 @@ def cached_fold(tm, times_cat, sizes, t_ref, delta, anchor_idx, exact_fn,
             _disk_put(key, new, disk_dir)
     _last_info = info
     return folded, info
+
+
+# ---------------------------------------------------------------------------
+# Batched warm refolds (the serving engine's one-dispatch steady state)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def refold_batch(folded: jax.Array, basis: jax.Array,
+                 dp: jax.Array) -> jax.Array:
+    """vmapped :func:`refold` over a leading client axis: (B, E) phases,
+    (B, E, P) bases, (B, P) updates -> (B, E) refolded phases.
+
+    Per-client bits match the solo kernel: vmap batches the fixed-order
+    column accumulation WITHOUT reassociating it (the same argument as
+    ``multisource.stacked_fold``), and padding is inert — zero basis
+    columns with zero dp contribute ``+ 0.0 * 0.0`` to phases that are
+    never ``-0.0`` (folded phases live in [0, 1)), which is a bitwise
+    identity, while padded event rows are sliced away before return.
+    """
+    return jax.vmap(refold)(folded, basis, dp)
+
+
+def _warm_entry(tm, seg_times, budget):
+    """One client's refold operands, mirroring fold_segments' layout
+    conventions byte-for-byte so the cache key matches the seeded one."""
+    tm = timing.resolve(tm)
+    seg = [np.atleast_1d(np.asarray(t, dtype=np.float64)) for t in seg_times]
+    t_ref = np.asarray([(t[-1] - t[0]) / 2 + t[0] if t.size else 0.0
+                        for t in seg])
+    sizes = [t.size for t in seg]
+    times_cat = np.concatenate(seg) if seg else np.zeros(0, dtype=np.float64)
+    if budget is None:
+        budget = resolve(times_cat.size, delta_fold=1)["budget"]
+    return tm, t_ref, sizes, times_cat, float(budget)
+
+
+def delta_refold_batch(tms, seg_times_lists, tags=None, budget=None):
+    """Refold every admitted warm client in ONE stacked device dispatch.
+
+    Inputs are parallel lists (one slot per client): timing models, the
+    per-segment event-time lists exactly as ``fold_segments`` would see
+    them, and the cache tags (the serving engine passes client ids).
+    Returns ``(phase_lists, t_refs, infos)`` aligned with the inputs;
+    ``phase_lists[i]`` is the per-segment refolded phases, or ``None``
+    when client *i* must take the existing solo rung instead — cache
+    miss, nonlinear move, or a precision-guard trip demotes ONLY that
+    client (``infos[i]["fallback"]`` says why), never the batch.
+
+    Admitted clients pad to the batch's (max events x max params) and go
+    through :func:`refold_batch`; the zero padding is bitwise inert (see
+    the kernel docstring), so each row equals the solo ``refold`` bits.
+    Zero-``dp`` clients short-circuit to their stored product (the solo
+    cache-hit path) without joining the matmul.
+    """
+    from crimp_tpu.ops import anchored
+
+    n = len(tms)
+    tags = list(tags) if tags is not None else [None] * n
+    phase_lists: list = [None] * n
+    t_refs: list = [None] * n
+    infos: list = [{} for _ in range(n)]
+    mode, disk_dir = fold_cache_mode()
+    admitted = []  # (slot, prod, basis, dp, sizes, n_events)
+    for i in range(n):
+        tm, t_ref, sizes, times_cat, budget_i = _warm_entry(
+            tms[i], seg_times_lists[i], budget)
+        t_refs[i] = t_ref
+        info = infos[i]
+        info.update({"mode": None, "n_events": int(times_cat.size),
+                     "tag": tags[i]})
+        if mode == "off" or not times_cat.size:
+            info["fallback"] = "cache_off" if mode == "off" else "empty"
+            continue
+        pvec = linear_param_vector(tm)
+        nonlin = nonlinear_sha(tm)
+        key = fold_key(times_cat, sizes, t_ref, model_sha=nonlin,
+                       tag=tags[i])
+        info["key"] = key[:16]
+        try:
+            prod = _mem_get(key)
+            if prod is None and mode == "disk":
+                prod = _disk_get(key, disk_dir)
+                if prod is not None:
+                    _mem_put(key, prod)
+        except Exception as exc:  # noqa: BLE001 — cache-path failure
+            # demotes this client to the solo rung, where cached_fold's
+            # own fold ladder classifies and stamps it
+            info["fallback"] = resilience.classify(exc).value
+            continue
+        if prod is None:
+            info["fallback"] = "miss"
+            continue
+        if prod.nonlin != nonlin or prod.pvec.shape != pvec.shape:
+            info["fallback"] = "nonlinear"
+            continue
+        dp = pvec - prod.pvec
+        if not np.any(dp):
+            info["mode"] = "cache"
+            obs.counter_add("delta_fold_cache_hits")
+            phase_lists[i] = np.split(prod.phases.copy(),
+                                      np.cumsum(sizes)[:-1])
+            continue
+        anchor_idx = np.repeat(np.arange(len(sizes)), sizes)
+        delta = anchored.anchor_deltas(times_cat, t_ref, anchor_idx)
+        basis = _ensure_basis(prod, tm, delta, anchor_idx)
+        bound = error_bound_cycles(basis.colmax, dp)
+        info["bound_cycles"] = bound
+        if bound > budget_i:
+            info["fallback"] = "budget"
+            obs.counter_add("delta_fold_guard_trips")
+            continue
+        admitted.append((i, prod, basis, dp, sizes, times_cat.size))
+    if not admitted:
+        return phase_lists, t_refs, infos
+    n_ev = max(a[5] for a in admitted)
+    n_par = max(int(a[2].b.shape[1]) for a in admitted)
+    folded_pad = np.zeros((len(admitted), n_ev), dtype=np.float64)
+    basis_pad = np.zeros((len(admitted), n_ev, n_par), dtype=np.float64)
+    dp_pad = np.zeros((len(admitted), n_par), dtype=np.float64)
+    for r, (_, prod, basis, dp, _, n_i) in enumerate(admitted):
+        folded_pad[r, :n_i] = prod.phases
+        basis_pad[r, :n_i, :basis.b.shape[1]] = np.asarray(basis.b)
+        dp_pad[r, :dp.size] = dp
+    args = (jnp.asarray(folded_pad), jnp.asarray(basis_pad),
+            jnp.asarray(dp_pad))
+    out = np.asarray(refold_batch(*args))
+    costmodel.capture("delta_refold_batch", refold_batch, *args)
+    obs.counter_add("delta_fold_refolds", len(admitted))
+    for r, (i, _, _, _, sizes, n_i) in enumerate(admitted):
+        infos[i]["mode"] = "delta"
+        infos[i]["batched"] = True
+        phase_lists[i] = np.split(
+            np.ascontiguousarray(out[r, :n_i]), np.cumsum(sizes)[:-1])
+    return phase_lists, t_refs, infos

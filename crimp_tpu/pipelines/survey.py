@@ -33,6 +33,7 @@ per dispatch.
 
 from __future__ import annotations
 
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -233,7 +234,7 @@ def measure_source_toas(spec: SourceSpec, phShiftRes: int = 1000,
     return _assemble_frame(prep, toa_mids, results, h_powers)
 
 
-def compute_bucket(ps: list[_Prepped]):
+def compute_bucket(ps: list[_Prepped], phase_lists=None, t_refs=None):
     """Batched fold + fit + H-test for one bucket of prepped sources.
 
     ``ps`` share (kind, cfg, n_comp) — the executable-sharing grouping the
@@ -243,11 +244,18 @@ def compute_bucket(ps: list[_Prepped]):
     conversion) so callers can seed the delta-fold cache with the
     bit-identical fold product.  Shared by :func:`_survey_impl` and the
     serving engine's continuous-batching dispatch (crimp_tpu/serve).
+
+    Callers that already hold the cycle-folded phases — the serving
+    engine's batched warm path refolds them via
+    ``deltafold.delta_refold_batch`` — pass ``phase_lists``/``t_refs``
+    (both, aligned with ``ps``) to skip the fold and route straight into
+    the batched fits and H-test.
     """
     kind, cfg = ps[0].kind, ps[0].cfg
-    phase_lists, t_refs = multisource.fold_sources(
-        [p.tm for p in ps], [p.seg_times for p in ps]
-    )
+    if phase_lists is None or t_refs is None:
+        phase_lists, t_refs = multisource.fold_sources(
+            [p.tm for p in ps], [p.seg_times for p in ps]
+        )
     fit_lists = phase_lists
     if kind in (profiles.CAUCHY, profiles.VONMISES):
         fit_lists = [[ph * (2 * np.pi) for ph in pl] for pl in phase_lists]
@@ -349,9 +357,11 @@ def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
     occ_used = occ_total = 0
     splits = 0
     obs.beat(0, n_total, label="sources", force=True)
-    queue = list(buckets)
+    # deque, not a list: pop(0) on a list shifts every element, turning a
+    # many-bucket round (plus its split-retries) into O(n^2) host work
+    queue = deque(buckets)
     while queue:
-        bucket = queue.pop(0)
+        bucket = queue.popleft()
         ps = [preps[i] for i in bucket]
         try:
             faultinject.fire("survey_bucket")
@@ -369,8 +379,8 @@ def _survey_impl(specs, phShiftRes, nbrBins, varyAmps):
             fkind = resilience.classify(exc)
             if len(bucket) > 1:
                 mid = (len(bucket) + 1) // 2
-                queue.insert(0, bucket[mid:])
-                queue.insert(0, bucket[:mid])
+                queue.appendleft(bucket[mid:])
+                queue.appendleft(bucket[:mid])
                 splits += 1
                 resilience.record_degradation("multisource", "split_bucket",
                                               fkind)

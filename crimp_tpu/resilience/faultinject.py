@@ -36,6 +36,7 @@ FAULT_POINTS = frozenset({
     "serve_admission",  # serve/admission.py: request admission
     "serve_dispatch",  # serve/engine.py: batched/warm request dispatch
     "serve_deadline",  # serve/scheduler.py: deadline-budget evaluation
+    "serve_warm_batch",  # serve/engine.py: stacked warm-refold dispatch
 })
 
 # Spec kind name -> FailureKind the injected exception will classify as.

@@ -3,12 +3,13 @@
 The batch CLI pays dispatch, compile-cache lookup, and host staging per
 invocation; a timing service amortizes them across a process lifetime.
 :class:`ServingEngine` holds the AOT-warmed executables and the
-delta-fold cache resident, admits requests through a BOUNDED queue
-(backpressure, typed rejections), forms continuous batches through the
-multisource engine, and degrades along the parity-pinned resilience
-ladder — pre-emptively when a deadline budget demands it, reactively
-when a dispatch fails, with per-rung circuit breakers remembering sick
-rungs.
+delta-fold cache resident, admits requests through BOUNDED per-priority-
+class queues (backpressure, typed rejections, deficit-round-robin fair
+drain), forms continuous batches through the multisource engine — warm
+clients re-time as ONE stacked ``refold_batch`` dispatch per round — and
+degrades along the parity-pinned resilience ladders — pre-emptively when
+a deadline budget demands it, reactively when a dispatch fails, with
+per-rung circuit breakers remembering sick rungs.
 
 The serving contract (docs/serving.md): every request either completes
 bit-identically, completes degraded (stamped via ``record_degradation``),
@@ -20,16 +21,18 @@ batch pipelines are bit-identical with or without it.
 """
 
 from crimp_tpu.serve.admission import (AdmissionQueue, AdmissionRejected,
-                                       TimingRequest, queue_capacity)
+                                       PRIORITY_CLASSES, TimingRequest,
+                                       queue_capacity)
 from crimp_tpu.serve.breaker import RungBreakers, breaker_threshold
 from crimp_tpu.serve.engine import RequestResult, ServingEngine
 from crimp_tpu.serve.loadgen import poisson_arrivals, run_load
 from crimp_tpu.serve.scheduler import (DeadlineScheduler, LADDER,
+                                       WARM_BATCH_RUNG, WARM_RUNG,
                                        default_deadline_s)
 
 __all__ = [
     "AdmissionQueue", "AdmissionRejected", "DeadlineScheduler", "LADDER",
-    "RequestResult", "RungBreakers", "ServingEngine", "TimingRequest",
-    "breaker_threshold", "default_deadline_s", "poisson_arrivals",
-    "queue_capacity", "run_load",
+    "PRIORITY_CLASSES", "RequestResult", "RungBreakers", "ServingEngine",
+    "TimingRequest", "WARM_BATCH_RUNG", "WARM_RUNG", "breaker_threshold",
+    "default_deadline_s", "poisson_arrivals", "queue_capacity", "run_load",
 ]

@@ -1,4 +1,4 @@
-"""Bounded admission queue with backpressure.
+"""Bounded admission queue with priority classes and fair-queue drain.
 
 The serving engine's front door: requests enter through
 :meth:`AdmissionQueue.offer`, which either accepts (the request becomes a
@@ -10,10 +10,19 @@ overload the caller learns immediately and can shed, retry elsewhere, or
 wait — the engine's own latency never inflates by queue depth it cannot
 serve.
 
-Capacity comes from ``CRIMP_TPU_SERVE_QUEUE`` (default 64); the
-``serve_admission`` fault point fires inside :meth:`offer` so chaos tests
-can drive admission-time failures — an injected fault surfaces as the
-same classified rejection an organic one would.
+Priority classes (``TimingRequest.priority``: high / normal / low) get
+PER-CLASS bounded sub-queues — a chatty low-priority client saturating
+its own sub-queue can never evict or block high-priority admission — and
+:meth:`drain` interleaves the classes by deficit round-robin with the
+:data:`PRIORITY_CLASSES` weights as quanta: every non-empty class makes
+progress each round (no starvation), heavier classes proportionally more.
+Within a class the order stays FIFO and deadline scheduling is unchanged
+(the rung scheduler sees per-request budgets exactly as before).
+
+Capacity comes from ``CRIMP_TPU_SERVE_QUEUE`` (default 64, applied per
+class); the ``serve_admission`` fault point fires inside :meth:`offer` so
+chaos tests can drive admission-time failures — an injected fault
+surfaces as the same classified rejection an organic one would.
 """
 
 from __future__ import annotations
@@ -27,6 +36,12 @@ from crimp_tpu.resilience import faultinject, taxonomy
 from crimp_tpu.resilience.taxonomy import CrimpError, FailureKind
 
 DEFAULT_QUEUE_CAP = 64
+
+# Priority classes in drain-precedence order, with their deficit-round-
+# robin quanta (requests per drain round while backlogged).  Weighted
+# fair queueing, not strict priority: a backlogged low class still
+# drains 1 request per round against high's 4.
+PRIORITY_CLASSES = {"high": 4, "normal": 2, "low": 1}
 
 
 class AdmissionRejected(CrimpError):
@@ -53,13 +68,16 @@ class TimingRequest:
     submission; None defers to ``CRIMP_TPU_SERVE_DEADLINE_MS`` (unset =
     no deadline).  ``submitted_at`` (perf_counter seconds) is stamped at
     admission; the load generator pre-stamps the scheduled arrival time
-    so open-loop latencies include queue wait.
+    so open-loop latencies include queue wait.  ``priority`` names one of
+    the :data:`PRIORITY_CLASSES` (default "normal"): it picks the bounded
+    per-class sub-queue and the fair-queue drain weight, nothing else.
     """
 
     spec: object
     deadline_s: float | None = None
     submitted_at: float | None = None
     fit_kwargs: dict = field(default_factory=dict)
+    priority: str = "normal"
 
     @property
     def client_id(self) -> str:
@@ -76,19 +94,22 @@ def queue_capacity() -> int:
 
 
 class AdmissionQueue:
-    """FIFO of admitted requests, capped; full = typed rejection."""
+    """Per-class FIFOs of admitted requests, each capped; full = typed
+    rejection; drained by weighted deficit round-robin."""
 
     def __init__(self, capacity: int | None = None):
         self.capacity = int(capacity) if capacity is not None \
             else queue_capacity()
         if self.capacity < 1:
             raise ValueError("admission queue capacity must be >= 1")
-        self._q: deque[TimingRequest] = deque()
+        self._queues: dict[str, deque[TimingRequest]] = {
+            cls: deque() for cls in PRIORITY_CLASSES}
+        self._deficit: dict[str, int] = {cls: 0 for cls in PRIORITY_CLASSES}
         self.admitted = 0
         self.rejected = 0
 
     def __len__(self) -> int:
-        return len(self._q)
+        return sum(len(q) for q in self._queues.values())
 
     def offer(self, request: TimingRequest) -> TimingRequest:
         """Admit ``request`` or raise :class:`AdmissionRejected`.
@@ -124,27 +145,58 @@ class AdmissionQueue:
             raise AdmissionRejected(
                 f"deadline_s={request.deadline_s!r} must be > 0",
                 FailureKind.DATA_ERROR)
-        if len(self._q) >= self.capacity:
+        if request.priority not in PRIORITY_CLASSES:
+            self.rejected += 1
+            obs.counter_add("serve_rejected", 1)
+            raise AdmissionRejected(
+                f"priority={request.priority!r} is not a declared class "
+                f"({'/'.join(PRIORITY_CLASSES)})", FailureKind.DATA_ERROR)
+        if len(self._queues[request.priority]) >= self.capacity:
             self.rejected += 1
             obs.counter_add("serve_rejected", 1)
             obs.counter_add("serve_queue_full", 1)
             raise AdmissionRejected(
-                f"admission queue full ({self.capacity} pending): "
-                "resource exhausted, retry after the next batch drains",
+                f"admission queue full for class {request.priority!r} "
+                f"({self.capacity} pending): resource exhausted, retry "
+                "after the next batch drains",
                 FailureKind.RESOURCE_EXHAUSTED)
         if request.submitted_at is None:
             request.submitted_at = time.perf_counter()
-        self._q.append(request)
+        self._queues[request.priority].append(request)
         self.admitted += 1
         obs.counter_add("serve_admitted", 1)
+        obs.counter_add(f"serve_admitted_{request.priority}", 1)
         return request
 
     def drain(self, n: int | None = None) -> list[TimingRequest]:
         """Pop up to ``n`` admitted requests (all of them when None) —
-        the next continuous-batching round's rows."""
-        take = len(self._q) if n is None else min(int(n), len(self._q))
-        return [self._q.popleft() for _ in range(take)]
+        the next continuous-batching round's rows.
+
+        Deficit round-robin across the priority classes: each round every
+        non-empty class earns its :data:`PRIORITY_CLASSES` quantum and
+        pops that many requests (FIFO within the class), so a saturated
+        low class can delay a high request by at most a bounded number of
+        slots per round — never starve it.  Unspent deficit carries to
+        the next drain while a class stays backlogged and resets when its
+        sub-queue empties (standard DRR).
+        """
+        total = len(self)
+        take = total if n is None else min(int(n), total)
+        out: list[TimingRequest] = []
+        while len(out) < take:
+            for cls, weight in PRIORITY_CLASSES.items():
+                q = self._queues[cls]
+                if not q:
+                    self._deficit[cls] = 0
+                    continue
+                self._deficit[cls] += weight
+                while q and self._deficit[cls] > 0 and len(out) < take:
+                    out.append(q.popleft())
+                    self._deficit[cls] -= 1
+                if not q:
+                    self._deficit[cls] = 0
+        return out
 
 
 __all__ = ["AdmissionQueue", "AdmissionRejected", "DEFAULT_QUEUE_CAP",
-           "TimingRequest", "queue_capacity"]
+           "PRIORITY_CLASSES", "TimingRequest", "queue_capacity"]

@@ -16,33 +16,54 @@ Request lifecycle (docs/serving.md has the state machine):
    picks the highest ladder rung the remaining budget affords and the
    per-rung circuit breakers (serve/breaker.py) admit;
 3. **dispatch** — cold clients batch at the picked rung; RETURNING
-   clients take the delta-fold hot path (``measure_source_toas`` with
-   ``delta_fold=1`` and ``cache_tag`` = client name): a re-timing is one
-   ``B @ dp`` matmul against the cached fold product, seeded from the
-   client's first (batched, bit-identical) fold;
+   clients take the delta-fold hot path: with the warm-batch knob on
+   (``CRIMP_TPU_SERVE_WARM_BATCH`` via ``resolve_serve_warm_batch``, the
+   default) every warm client in the round refolds in ONE
+   ``deltafold.delta_refold_batch`` dispatch (rung ``warm_batched``) and
+   the post-refold template fits ride the already-batched
+   ``fit_sources``; with the knob off, or for a client the batch demotes
+   (cache miss / nonlinear move / precision-guard trip), the request
+   re-times solo (rung ``warm``) through ``measure_source_toas`` with
+   ``delta_fold=1`` and ``cache_tag`` = client name — one ``B @ dp``
+   matvec against the cached fold product, seeded from the client's
+   first (batched, bit-identical) fold.  Per-client bits are identical
+   on both warm rungs;
 4. **completion** — every admitted request resolves as ``ok``
    (bit-identical to the parity-pinned reference path), ``degraded``
    (stamped via ``record_degradation``), or ``error`` with a classified
    record (DATA_ERROR never degrades — bad input fails the same on every
    rung).  No request ever returns an unclassified error.
 
+Host-side request prep (longdouble anchoring via ``survey._prep_source``)
+overlaps the previous round's dispatch: :meth:`ServingEngine.submit`
+hands each admitted spec to a bounded SINGLE-worker prep stage and
+:meth:`step` consumes the futures in drain order — deterministic
+completion order, results bit-identical to the serial path (prep is a
+pure function of the spec), and ``CRIMP_TPU_SERVE_PREP_OVERLAP=0`` pins
+the serial order outright.
+
 Failure domains are inherited from ``pipelines/survey.py``: a failed
 bucket splits and retries, a single-request bucket demotes to the
 per-source rung, device-shaped per-source failures get one pinned-CPU
 attempt.  The ``serve_dispatch`` fault point fires on every batched and
 warm dispatch (NOT on the per-source bottom rung — the ladder's floor is
-the clean path, mirroring ``survey_bucket``).
+the clean path, mirroring ``survey_bucket``); ``serve_warm_batch`` fires
+inside the stacked warm dispatch, whose failure walks the ``serve_warm``
+ladder (``warm_batched -> solo``) and demotes the batch to per-request
+warm dispatches.
 """
 
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
 
-from crimp_tpu import obs, resilience
+from crimp_tpu import knobs, obs, resilience
 from crimp_tpu.pipelines import survey
 from crimp_tpu.resilience import faultinject
 from crimp_tpu.resilience.taxonomy import FailureKind
@@ -95,7 +116,9 @@ class ServingEngine:
                  scheduler: scheduler_mod.DeadlineScheduler | None = None,
                  breakers: breaker_mod.RungBreakers | None = None,
                  phShiftRes: int = 1000, nbrBins: int = 15,
-                 varyAmps: bool = False, mesh=None):
+                 varyAmps: bool = False, mesh=None,
+                 warm_batch: int | None = None,
+                 prep_overlap: bool | None = None):
         self.queue = queue if queue is not None else AdmissionQueue()
         self.scheduler = scheduler if scheduler is not None \
             else scheduler_mod.DeadlineScheduler()
@@ -106,6 +129,12 @@ class ServingEngine:
         self.varyAmps = bool(varyAmps)
         self._default_deadline = scheduler_mod.default_deadline_s()
         self._warm: set[str] = set()  # clients with a seeded fold product
+        # None defers to the knob/autotune resolution per round; 0/1 and
+        # True/False pin the path (bench_serving's A/B arms use this)
+        self._warm_batch = warm_batch
+        self._prep_overlap = prep_overlap
+        self._prep_pool: concurrent.futures.ThreadPoolExecutor | None = None
+        self._prep_futures: dict[int, concurrent.futures.Future] = {}
         self.counts = {"ok": 0, "degraded": 0, "error": 0,
                        "deadline_miss": 0, "steps": 0}
         # capacity note: the (optionally global, multi-host) mesh the
@@ -145,15 +174,40 @@ class ServingEngine:
 
         return crimp_tpu.warmup(**kwargs)
 
-    def submit(self, spec, deadline_s: float | None = None) -> TimingRequest:
+    def submit(self, spec, deadline_s: float | None = None,
+               priority: str = "normal") -> TimingRequest:
         """Admit one request (a survey ``SourceSpec`` or a prebuilt
         :class:`TimingRequest`); raises :class:`AdmissionRejected` with a
-        taxonomy kind on refusal."""
+        taxonomy kind on refusal.  ``priority`` picks the admission
+        class (high / normal / low — serve/admission.py)."""
         req = spec if isinstance(spec, TimingRequest) \
-            else TimingRequest(spec=spec, deadline_s=deadline_s)
+            else TimingRequest(spec=spec, deadline_s=deadline_s,
+                               priority=priority)
         if req.deadline_s is None:
             req.deadline_s = self._default_deadline
-        return self.queue.offer(req)
+        req = self.queue.offer(req)
+        if self._prep_overlap_on():
+            self._schedule_prep(req)
+        return req
+
+    def _prep_overlap_on(self) -> bool:
+        """Constructor pin > CRIMP_TPU_SERVE_PREP_OVERLAP > on."""
+        if self._prep_overlap is not None:
+            return bool(self._prep_overlap)
+        env = knobs.env_onoff("CRIMP_TPU_SERVE_PREP_OVERLAP")
+        return True if env is None else env
+
+    def _schedule_prep(self, req: TimingRequest) -> None:
+        """Queue this request's host-side prep behind the single prep
+        worker, overlapping it with whatever round is dispatching now.
+        Prep is a pure function of the spec and the futures are consumed
+        in drain order, so results are bit-identical to serial prep."""
+        if self._prep_pool is None:
+            self._prep_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="crimp-serve-prep")
+        self._prep_futures[id(req)] = self._prep_pool.submit(
+            survey._prep_source, req.spec, self.phShiftRes, self.nbrBins,
+            self.varyAmps)
 
     # -- one continuous-batching round --------------------------------------
 
@@ -167,20 +221,28 @@ class ServingEngine:
         pend = [_Pending(req=r) for r in batch]
         obs.beat(0, len(pend), label="serve", force=True)
 
+        futures = [self._prep_futures.pop(id(p.req), None) for p in pend]
+        obs.gauge_set("serve_prep_overlap_ready",
+                      sum(1 for f in futures if f is not None and f.done()))
         warm: list[_Pending] = []
         cold: list[_Pending] = []
-        for p in pend:
+        for p, fut in zip(pend, futures):
             try:
-                p.prep = survey._prep_source(
-                    p.req.spec, self.phShiftRes, self.nbrBins, self.varyAmps)
+                # the overlapped prep (scheduled at admission) lands here
+                # in drain order; requests admitted without one (overlap
+                # off, or offered straight to the queue) prep serially —
+                # either way the prep is the same pure function of the spec
+                p.prep = fut.result() if fut is not None else \
+                    survey._prep_source(p.req.spec, self.phShiftRes,
+                                        self.nbrBins, self.varyAmps)
             except Exception as exc:  # noqa: BLE001 — per-request failure
                 # domain: a malformed spec fails CLASSIFIED, poisons nothing
                 p.result = self._error_result(p, resilience.error_record(exc))
                 continue
             (warm if p.req.client_id in self._warm else cold).append(p)
 
-        for p in warm:
-            self._dispatch_warm(p)
+        if warm:
+            self._dispatch_warm_group(warm)
 
         if cold:
             self._dispatch_cold(cold)
@@ -208,6 +270,127 @@ class ServingEngine:
 
     # -- warm clients: the delta-fold hot path ------------------------------
 
+    def _dispatch_warm_group(self, warm: list[_Pending]) -> None:
+        """Route the round's warm clients: one stacked refold dispatch
+        when the warm-batch knob resolves on (constructor pin >
+        CRIMP_TPU_SERVE_WARM_BATCH > cached A/B verdict > on), else the
+        per-request loop.  Both paths produce identical per-client bits —
+        the knob trades dispatch count, not results."""
+        from crimp_tpu.ops import autotune
+
+        enabled = self._warm_batch
+        if enabled is None:
+            max_seg = max(max((p.prep.max_seg for p in warm), default=1), 1)
+            enabled = autotune.resolve_serve_warm_batch(
+                len(warm), max_seg)["serve_warm_batch"]
+        if not enabled or len(warm) < 2:
+            for p in warm:
+                self._dispatch_warm(p)
+            return
+        self._dispatch_warm_batch(warm)
+
+    def _dispatch_warm_batch(self, warm: list[_Pending]) -> None:
+        """All warm refolds of a round as one stacked device dispatch.
+
+        Clients group by the executable-sharing key and bucket by padded
+        width exactly like the cold path, each bucket refolds through
+        ``deltafold.delta_refold_batch`` (rung ``warm_batched``), and the
+        post-refold template fits route through the already-batched
+        ``survey.compute_bucket`` fits.  A client the batch cannot serve
+        (cache miss, nonlinear move, precision-guard trip) demotes ALONE
+        to the solo warm rung — that is the precision machinery choosing
+        the exact path, not a degradation.  A failure of the stacked
+        dispatch itself walks the ``serve_warm`` ladder
+        (``warm_batched -> solo``) and demotes the bucket, stamped
+        degraded.
+        """
+        from crimp_tpu.ops import autotune, multisource
+
+        groups: dict[tuple, list[_Pending]] = {}
+        for p in warm:
+            pr = p.prep
+            groups.setdefault((pr.kind, pr.cfg, int(pr.tpl.n_comp)),
+                              []).append(p)
+        max_seg = max(max((p.prep.max_seg for p in warm), default=1), 1)
+        resolved = autotune.resolve_multisource(len(warm), max_seg)
+        for members in groups.values():
+            for b in multisource.bucket_sources(
+                [max(m.prep.max_seg, 1) for m in members],
+                max_pad_ratio=resolved["max_pad"],
+                batch_cap=resolved["batch_cap"],
+            ):
+                self._dispatch_warm_bucket([members[j] for j in b])
+
+    def _dispatch_warm_bucket(self, bucket: list[_Pending]) -> None:
+        from crimp_tpu.ops import deltafold
+
+        t0 = time.perf_counter()
+        try:
+            faultinject.fire("serve_warm_batch")
+            phase_lists, t_refs, infos = deltafold.delta_refold_batch(
+                [m.prep.tm for m in bucket],
+                [m.prep.seg_times for m in bucket],
+                tags=[m.req.client_id for m in bucket])
+        except Exception as exc:  # noqa: BLE001 — stacked-refold failure
+            # domain: bad data errors out, anything else drops the whole
+            # bucket one serve_warm rung, to per-request warm
+            self._demote_warm_bucket(bucket, exc, resilience.classify(exc))
+            return
+        keep: list[_Pending] = []
+        kept_phases, kept_refs = [], []
+        for m, pl, tr, info in zip(bucket, phase_lists, t_refs, infos):
+            if pl is None:
+                # per-client demotion to the solo warm rung (cache miss /
+                # nonlinear / budget): normal precision machinery, not a
+                # degradation — cached_fold re-runs the exact fold there
+                obs.counter_add("serve_warm_batch_demotes", 1)
+                self._dispatch_warm(m)
+                continue
+            m.extra["fold_mode"] = info.get("mode") or "delta"
+            keep.append(m)
+            kept_phases.append(pl)
+            kept_refs.append(tr)
+        if not keep:
+            return
+        try:
+            frames, _, _ = survey.compute_bucket(
+                [m.prep for m in keep], phase_lists=kept_phases,
+                t_refs=kept_refs)
+            wall = time.perf_counter() - t0
+            self.scheduler.observe(scheduler_mod.WARM_BATCH_RUNG,
+                                   wall / len(keep))
+            obs.counter_add("serve_warm_batched", len(keep))
+            for m, frame in zip(keep, frames):
+                mode = m.extra["fold_mode"]
+                obs.counter_add(f"serve_warm_{mode}", 1)
+                m.result = RequestResult(
+                    client_id=m.req.client_id,
+                    status="degraded" if m.degraded else "ok",
+                    frame=frame, rung=scheduler_mod.WARM_BATCH_RUNG,
+                    path=f"delta_fold:{mode}")
+        except Exception as exc:  # noqa: BLE001 — the batched-fit half of
+            # the stacked dispatch shares the refold's failure domain
+            self._demote_warm_bucket(keep, exc, resilience.classify(exc))
+
+    def _demote_warm_bucket(self, bucket: list[_Pending], exc,
+                            fkind) -> None:
+        """Walk the serve_warm ladder: the stacked dispatch failed, so
+        every member re-dispatches per-request at the solo warm rung,
+        stamped degraded (DATA_ERROR errors out instead — bad input fails
+        the same on every rung)."""
+        if fkind is FailureKind.DATA_ERROR:
+            for m in bucket:
+                m.result = self._error_result(m, resilience.error_record(exc))
+            return
+        resilience.record_degradation("serve_warm", "solo", fkind)
+        obs.counter_add("serve_warm_batch_demotes", len(bucket))
+        logger.warning("warm batch of %d failed (%s); demoting to solo "
+                       "warm dispatches", len(bucket), fkind.value,
+                       exc_info=True)
+        for m in bucket:
+            m.degraded = True
+            self._dispatch_warm(m)
+
     def _dispatch_warm(self, p: _Pending) -> None:
         t0 = time.perf_counter()
         try:
@@ -219,10 +402,12 @@ class ServingEngine:
 
             mode = deltafold.last_fold_info().get("mode") or "exact"
             p.result = RequestResult(
-                client_id=p.req.client_id, status="ok", frame=frame,
-                rung="batched", path=f"delta_fold:{mode}")
+                client_id=p.req.client_id,
+                status="degraded" if p.degraded else "ok", frame=frame,
+                rung=scheduler_mod.WARM_RUNG, path=f"delta_fold:{mode}")
             obs.counter_add(f"serve_warm_{mode}", 1)
-            self.scheduler.observe("batched", time.perf_counter() - t0)
+            self.scheduler.observe(scheduler_mod.WARM_RUNG,
+                                   time.perf_counter() - t0)
         except Exception as exc:  # noqa: BLE001 — warm-path failure domain:
             # classify; bad data errors out, anything else falls to the
             # per-source exact rung (stamped degraded)
@@ -276,7 +461,9 @@ class ServingEngine:
             pr = p.prep
             groups.setdefault((pr.kind, pr.cfg, int(pr.tpl.n_comp)),
                               []).append(p)
-        queue: list[list[_Pending]] = []
+        # deque, not a list: pop(0) shifts every pending bucket, turning
+        # a many-bucket round (plus split-retries) into O(n^2) host work
+        queue: deque[list[_Pending]] = deque()
         for members in groups.values():
             for b in multisource.bucket_sources(
                 [max(m.prep.max_seg, 1) for m in members],
@@ -294,7 +481,7 @@ class ServingEngine:
                     queue.append(bucket)
 
         while queue:
-            bucket = queue.pop(0)
+            bucket = queue.popleft()
             t0 = time.perf_counter()
             try:
                 faultinject.fire("serve_dispatch")
@@ -317,8 +504,8 @@ class ServingEngine:
                 self.breakers.record_failure(rung, fkind)
                 if len(bucket) > 1:
                     mid = (len(bucket) + 1) // 2
-                    queue.insert(0, bucket[mid:])
-                    queue.insert(0, bucket[:mid])
+                    queue.appendleft(bucket[mid:])
+                    queue.appendleft(bucket[:mid])
                     resilience.record_degradation("multisource",
                                                   "split_bucket", fkind)
                     for m in bucket:
@@ -364,7 +551,15 @@ class ServingEngine:
             else:
                 p.result = self._error_result(p, resilience.error_record(exc))
                 return
-        self._warm.add(p.req.client_id)
+        # Warmth is contingent on the fold cache CONFIRMING a product was
+        # stored under this client's tag (cache tier off, or a failed
+        # seed, keeps the client cold) — an optimistic flag here would
+        # send the next request down a guaranteed-cache-miss warm path.
+        from crimp_tpu.ops import deltafold
+
+        info = deltafold.last_fold_info()
+        if info.get("stored") and info.get("tag") == p.req.client_id:
+            self._warm.add(p.req.client_id)
         self.scheduler.observe("per_source", time.perf_counter() - t0)
         p.result = RequestResult(
             client_id=p.req.client_id,
@@ -386,10 +581,11 @@ class ServingEngine:
             phases_cat = np.concatenate(
                 [np.asarray(ph) for ph in phase_list]) if phase_list \
                 else np.zeros(0)
-            deltafold.store_product(m.prep.tm, times_cat, sizes,
-                                    np.asarray(t_ref), phases_cat,
-                                    tag=m.req.client_id)
-            self._warm.add(m.req.client_id)
+            key = deltafold.store_product(m.prep.tm, times_cat, sizes,
+                                          np.asarray(t_ref), phases_cat,
+                                          tag=m.req.client_id)
+            if key is not None:  # cache tier off returns None: stay cold
+                self._warm.add(m.req.client_id)
         except Exception as exc:  # noqa: BLE001 — seeding is a throughput
             # optimization; its failure is classified telemetry, never a
             # request failure (the client simply stays cold)

@@ -35,6 +35,15 @@ logger = logging.getLogger("crimp_tpu.serve")
 
 LADDER = resilience.LADDERS["multisource"]  # ("batched", "split_bucket",
 #                                              "per_source")
+# The warm (delta-fold) path's rung labels.  Kept DISTINCT from the cold
+# multisource ladder above so warm latency observations never pollute the
+# cold rungs' EWMA estimates — ``pick_rung`` only walks LADDER, so the
+# warm keys in ``estimates()`` are attribution-only.  WARM_BATCH_RUNG is
+# the top of ``resilience.LADDERS["serve_warm"]`` (demotions stamp
+# ``warm_batched -> solo``); WARM_RUNG labels the per-request solo warm
+# dispatch in results and observations.
+WARM_BATCH_RUNG = resilience.LADDERS["serve_warm"][0]  # "warm_batched"
+WARM_RUNG = "warm"
 EWMA_ALPHA = 0.3
 
 
@@ -109,4 +118,5 @@ class DeadlineScheduler:
             FailureKind.TIMEOUT if remaining_s is not None else None)
 
 
-__all__ = ["DeadlineScheduler", "EWMA_ALPHA", "LADDER", "default_deadline_s"]
+__all__ = ["DeadlineScheduler", "EWMA_ALPHA", "LADDER", "WARM_BATCH_RUNG",
+           "WARM_RUNG", "default_deadline_s"]

@@ -379,6 +379,27 @@ class TestGL003:
         assert any("CRIMP_TPU_DIST_COORD" in m and "unregistered" in m
                    for m in msgs)
 
+    def test_unregistered_serve_warm_batch_read_fires(self, tmp_path):
+        """The serving warm-batch knob is registered and read through
+        ops/autotune's resolver.  This fixture proves the gate would have
+        caught the PR that added the read WITHOUT the registration: with
+        the knob stripped from the registry, a raw environ read of
+        CRIMP_TPU_SERVE_WARM_BATCH turns the gate red."""
+        assert "CRIMP_TPU_SERVE_WARM_BATCH" in knobs.REGISTRY
+        reg = {k: v for k, v in knobs.REGISTRY.items()
+               if k != "CRIMP_TPU_SERVE_WARM_BATCH"}
+        rep = run_tree(tmp_path, {"pkg/serve_knob.py": """
+            import os
+
+            X = os.environ.get("CRIMP_TPU_SERVE_WARM_BATCH", "1")
+        """}, rules=("GL003",), registry=reg,
+            tools_md_text="\n".join(f"| `{k}` | x | x |" for k in reg),
+            numeric_keys=tuple(
+                k.numeric_key for k in reg.values() if k.numeric_key))
+        msgs = [f.message for f in rep.unwaived]
+        assert any("CRIMP_TPU_SERVE_WARM_BATCH" in m and "unregistered" in m
+                   for m in msgs)
+
 
 class TestGL003AgainstRepo:
     """The removal tests the issue pins: deleting a knob's docs row or its

@@ -415,3 +415,128 @@ class TestWindowBasisMatmul:
         resid = (y - mu) / err
         expect = -0.5 * np.sum(resid**2 + np.log(2 * np.pi * err**2))
         assert lp == pytest.approx(expect, rel=1e-12)
+
+
+class TestBatchedRefolds:
+    """The serving engine's stacked warm path (refold_batch /
+    delta_refold_batch): per-client bits equal the solo refold, padding is
+    inert, and every demotion reason routes the client back to the solo
+    rung instead of poisoning the batch."""
+
+    def _client_segs(self, n_clients=3, n_per=300, n_seg=3):
+        """Ragged per-client event sets (different sizes exercise the
+        batch padding)."""
+        out = []
+        for c in range(n_clients):
+            out.append(_segments(n_per=n_per - 40 * c, n_seg=n_seg,
+                                 seed=10 + c))
+        return out
+
+    def test_refold_batch_rows_match_solo_refold_bitwise(self):
+        """The kernel claim: vmap + zero padding never changes a row's
+        bits relative to the solo fixed-order refold."""
+        rng = np.random.default_rng(3)
+        shapes = [(500, 4), (350, 4), (500, 2)]
+        n_ev = max(s[0] for s in shapes)
+        n_par = max(s[1] for s in shapes)
+        folded_pad = np.zeros((len(shapes), n_ev))
+        basis_pad = np.zeros((len(shapes), n_ev, n_par))
+        dp_pad = np.zeros((len(shapes), n_par))
+        solos = []
+        for r, (ne, np_) in enumerate(shapes):
+            folded = rng.uniform(0.0, 1.0, ne)
+            basis = rng.uniform(-1e6, 1e6, (ne, np_))
+            dp = rng.uniform(-1e-9, 1e-9, np_)
+            solos.append(np.asarray(deltafold.refold(
+                jnp.asarray(folded), jnp.asarray(basis), jnp.asarray(dp))))
+            folded_pad[r, :ne] = folded
+            basis_pad[r, :ne, :np_] = basis
+            dp_pad[r, :np_] = dp
+        out = np.asarray(deltafold.refold_batch(
+            jnp.asarray(folded_pad), jnp.asarray(basis_pad),
+            jnp.asarray(dp_pad)))
+        for r, (ne, _) in enumerate(shapes):
+            assert np.array_equal(out[r, :ne], solos[r]), f"row {r}"
+
+    def test_delta_refold_batch_bitwise_vs_solo_cached_fold(self):
+        """End to end vs the solo rung: seed each client's product, move
+        F0, and require the one-dispatch batch to reproduce the solo
+        delta refold bit for bit."""
+        seg_lists = self._client_segs()
+        tms, tms_new = [], []
+        for c, segs in enumerate(seg_lists):
+            pars = {**BASE, "F0": BASE["F0"] + 1e-5 * c}
+            anchored.fold_segments(timing.from_dict(pars), segs,
+                                   delta_fold=1, cache_tag=f"c{c}")
+            tms.append(pars)
+            tms_new.append({**pars, "F0": pars["F0"] + (2 + c) * 1e-10})
+        phase_lists, t_refs, infos = deltafold.delta_refold_batch(
+            [timing.from_dict(p) for p in tms_new], seg_lists,
+            tags=[f"c{c}" for c in range(len(seg_lists))])
+        for c, segs in enumerate(seg_lists):
+            assert infos[c]["mode"] == "delta", infos[c]
+            assert infos[c].get("batched") is True
+            solo, _ = anchored.fold_segments(
+                timing.from_dict(tms_new[c]), segs, delta_fold=1,
+                cache_tag=f"c{c}")
+            assert deltafold.last_fold_info()["mode"] == "delta"
+            assert len(phase_lists[c]) == len(segs)
+            for seg_batch, seg_solo in zip(phase_lists[c], solo):
+                assert np.array_equal(seg_batch, np.asarray(seg_solo)), \
+                    f"client {c}"
+
+    def test_zero_dp_short_circuits_to_stored_product(self):
+        segs = self._client_segs(n_clients=1)[0]
+        ph, _ = anchored.fold_segments(timing.from_dict(BASE), segs,
+                                       delta_fold=1, cache_tag="same")
+        phase_lists, _, infos = deltafold.delta_refold_batch(
+            [timing.from_dict(BASE)], [segs], tags=["same"])
+        assert infos[0]["mode"] == "cache"
+        for seg_batch, seg_exact in zip(phase_lists[0], ph):
+            assert np.array_equal(seg_batch, np.asarray(seg_exact))
+
+    def test_guard_trip_demotes_only_the_offender(self):
+        """A precision-guard trip returns None for THAT client (the solo
+        rung re-runs it exactly); the rest of the batch still refolds."""
+        seg_lists = self._client_segs(n_clients=2)
+        for c, segs in enumerate(seg_lists):
+            anchored.fold_segments(timing.from_dict(BASE), segs,
+                                   delta_fold=1, cache_tag=f"g{c}")
+        moves = [{**BASE, "F0": BASE["F0"] + 0.1},      # bound >> budget
+                 {**BASE, "F0": BASE["F0"] + 1e-10}]    # comfortably inside
+        phase_lists, _, infos = deltafold.delta_refold_batch(
+            [timing.from_dict(m) for m in moves], seg_lists,
+            tags=["g0", "g1"])
+        assert phase_lists[0] is None
+        assert infos[0]["fallback"] == "budget"
+        assert phase_lists[1] is not None
+        assert infos[1]["mode"] == "delta"
+
+    def test_miss_and_cache_off_demote_to_solo(self, monkeypatch):
+        segs = self._client_segs(n_clients=1)[0]
+        phase_lists, _, infos = deltafold.delta_refold_batch(
+            [timing.from_dict(BASE)], [segs], tags=["never-seeded"])
+        assert phase_lists[0] is None
+        assert infos[0]["fallback"] == "miss"
+        monkeypatch.setenv("CRIMP_TPU_FOLD_CACHE", "0")
+        phase_lists, _, infos = deltafold.delta_refold_batch(
+            [timing.from_dict(BASE)], [segs], tags=["never-seeded"])
+        assert phase_lists[0] is None
+        assert infos[0]["fallback"] == "cache_off"
+
+    def test_nonlinear_move_demotes_that_client(self):
+        """A moved glitch epoch changes the nonlinear sha, which is part
+        of the cache key — the batch misses exactly like the solo rung
+        does and hands the client to it for an exact refold."""
+        segs = self._client_segs(n_clients=1)[0]
+        anchored.fold_segments(timing.from_dict(BASE), segs, delta_fold=1,
+                               cache_tag="nl")
+        moved_epoch = {**BASE, "GLEP_1": 58401.0}
+        phase_lists, _, infos = deltafold.delta_refold_batch(
+            [timing.from_dict(moved_epoch)], [segs], tags=["nl"])
+        assert phase_lists[0] is None
+        assert infos[0]["fallback"] == "miss"
+        # parity with the solo rung: it also treats the move as a miss
+        anchored.fold_segments(timing.from_dict(moved_epoch), segs,
+                               delta_fold=1, cache_tag="nl")
+        assert deltafold.last_fold_info()["mode"] == "exact"

@@ -108,6 +108,29 @@ class TestServingMetrics:
         assert {r["metric"] for r in report["improvements"]} == \
             {"requests_per_s", "p99_latency_ms"}
 
+    def test_warm_requests_per_s_gates_higher_is_better(self, tmp_path):
+        """The warm-heavy phase's steady-state throughput is a first-
+        class ledger metric: losing the stacked refold dispatch (e.g. a
+        silent knob regression) shows up as a gated regression."""
+        out = ledger.extract_metrics({"warm_requests_per_s": 41.0,
+                                      "warm_bitwise_match": True})
+        assert out["warm_requests_per_s"] == 41.0
+        assert "warm_bitwise_match" not in out  # bools never gate
+        base = self._serving_entries(tmp_path, 6, rps=20.0, p99=10.0,
+                                     warm_requests_per_s=40.0)
+        slow = self._serving_entries(tmp_path, 7, rps=20.0, p99=10.0,
+                                     warm_requests_per_s=20.0)
+        report = ledger.check(base + slow)
+        assert [r["metric"] for r in report["regressions"]] == \
+            ["warm_requests_per_s"]
+        assert report["ok"] is False
+        fast = self._serving_entries(tmp_path, 8, rps=20.0, p99=10.0,
+                                     warm_requests_per_s=80.0)
+        report = ledger.check(base + fast)
+        assert report["ok"] is True
+        assert {r["metric"] for r in report["improvements"]} == \
+            {"warm_requests_per_s"}
+
     def test_degraded_serving_round_never_gates(self, tmp_path):
         # a chaos/degraded serving round is excluded: it can neither
         # ratchet the baseline down nor fail the gate

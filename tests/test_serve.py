@@ -49,7 +49,8 @@ def _clean_slate(monkeypatch):
                 "CRIMP_TPU_BACKOFF_S", "CRIMP_TPU_FOLD_CACHE",
                 "CRIMP_TPU_DELTA_FOLD", "CRIMP_TPU_MULTISOURCE",
                 "CRIMP_TPU_SERVE_QUEUE", "CRIMP_TPU_SERVE_DEADLINE_MS",
-                "CRIMP_TPU_SERVE_BREAKER"):
+                "CRIMP_TPU_SERVE_BREAKER", "CRIMP_TPU_SERVE_WARM_BATCH",
+                "CRIMP_TPU_SERVE_PREP_OVERLAP"):
         monkeypatch.delenv(var, raising=False)
     monkeypatch.setenv("CRIMP_TPU_AUTOTUNE", "0")
     faultinject.reset()
@@ -606,3 +607,384 @@ class TestOffPath:
         monkeypatch.setenv("CRIMP_TPU_SERVE_QUEUE", "garbage")
         rng = np.random.RandomState(24)
         survey.measure_source_toas(_make_spec(0, rng), phShiftRes=200)
+
+# ---------------------------------------------------------------------------
+# warm fast path: the stacked refold dispatch
+# ---------------------------------------------------------------------------
+
+
+def _register(eng, specs):
+    """Cold round that seeds every client's fold-product slot."""
+    for s in specs:
+        eng.submit(s)
+    reg = eng.step()
+    assert all(r.status == "ok" for r in reg), \
+        [(r.client_id, r.status, r.error) for r in reg]
+    return reg
+
+
+class TestWarmBatch:
+    def test_batched_warm_round_bitwise_matches_solo_loop(self, obs_on):
+        """The tentpole pin: one stacked refold dispatch per round, with
+        per-client bits equal to the per-request warm loop's."""
+        rng = np.random.RandomState(30)
+        specs = [_make_spec(i, rng) for i in range(3)]
+
+        def arm(pin):
+            deltafold.clear_cache()
+            eng = _engine(warm_batch=pin)
+            _register(eng, specs)
+            for s in specs:
+                eng.submit(_reissue(s, f0_bump=1e-11))
+            return eng.step()
+
+        with obs.run("serve_warm_ab"):
+            solo = arm(0)
+            batched = arm(1)
+        assert [r.rung for r in solo] == [scheduler_mod.WARM_RUNG] * 3
+        assert [r.rung for r in batched] == \
+            [scheduler_mod.WARM_BATCH_RUNG] * 3
+        assert all(r.status == "ok" for r in solo + batched)
+        assert all(r.path == "delta_fold:delta" for r in solo + batched)
+        for a, b in zip(solo, batched):
+            assert a.client_id == b.client_id
+            _assert_bitwise(b.frame, a.frame, a.client_id)
+
+    def test_knob_off_pins_the_per_request_loop(self, monkeypatch, obs_on):
+        """CRIMP_TPU_SERVE_WARM_BATCH=0 through the autotune resolver is
+        bit-identical to the pre-batch path (rung "warm" per request)."""
+        monkeypatch.setenv("CRIMP_TPU_SERVE_WARM_BATCH", "0")
+        rng = np.random.RandomState(31)
+        specs = [_make_spec(i, rng) for i in range(2)]
+        solos = [survey.measure_source_toas(s, phShiftRes=200)
+                 for s in specs]
+        deltafold.clear_cache()
+        eng = _engine()  # warm_batch=None: resolves through the knob
+        with obs.run("serve_warm_off"):
+            _register(eng, specs)
+            for s in specs:
+                eng.submit(_reissue(s))  # unchanged: the cache-hit path
+            warm = eng.step()
+        assert [r.rung for r in warm] == [scheduler_mod.WARM_RUNG] * 2
+        assert all(r.path == "delta_fold:cache" for r in warm)
+        for r, solo, s in zip(warm, solos, specs):
+            _assert_bitwise(r.frame, solo, s.name)
+
+    def test_warm_rung_labels_are_distinct_in_observations(self, obs_on):
+        """Satellite: warm dispatches observe/label their own rungs and
+        never pollute the cold ladder's EWMA estimates."""
+        rng = np.random.RandomState(32)
+        specs = [_make_spec(i, rng) for i in range(2)]
+        deltafold.clear_cache()
+        eng = _engine(warm_batch=1)
+        with obs.run("serve_warm_labels"):
+            _register(eng, specs)
+            cold_est = dict(eng.scheduler.estimates())
+            for s in specs:
+                eng.submit(_reissue(s, f0_bump=1e-11))
+            warm = eng.step()
+        est = eng.scheduler.estimates()
+        assert scheduler_mod.WARM_BATCH_RUNG in est
+        assert scheduler_mod.WARM_BATCH_RUNG not in scheduler_mod.LADDER
+        assert scheduler_mod.WARM_RUNG not in scheduler_mod.LADDER
+        # the cold rungs' estimates did not move on a warm-only round
+        for rung in scheduler_mod.LADDER:
+            assert est.get(rung) == cold_est.get(rung)
+        assert {r.rung for r in warm} == {scheduler_mod.WARM_BATCH_RUNG}
+
+    def test_guard_trip_demotes_only_the_offender(self, obs_on):
+        """A precision-guard trip sends THAT client to the solo rung
+        (status ok — the exact path is the precision machinery working);
+        the rest of the batch stays stacked, and nothing is degraded."""
+        rng = np.random.RandomState(33)
+        specs = [_make_spec(i, rng) for i in range(3)]
+        deltafold.clear_cache()
+        eng = _engine(warm_batch=1)
+        with obs.run("serve_warm_guard"):
+            _register(eng, specs)
+            # client 0 moves far beyond the refold budget; 1 and 2 nudge
+            eng.submit(_reissue(specs[0], f0_bump=1.0))
+            eng.submit(_reissue(specs[1], f0_bump=1e-11))
+            eng.submit(_reissue(specs[2], f0_bump=1e-11))
+            warm = eng.step()
+            rec = obs.active()
+            counters = dict(rec.counters)
+        by_id = {r.client_id: r for r in warm}
+        offender = by_id[specs[0].name]
+        assert offender.status == "ok"
+        assert offender.rung == scheduler_mod.WARM_RUNG
+        assert offender.path == "delta_fold:exact"
+        for s in specs[1:]:
+            assert by_id[s.name].status == "ok"
+            assert by_id[s.name].rung == scheduler_mod.WARM_BATCH_RUNG
+            assert by_id[s.name].path == "delta_fold:delta"
+        assert counters.get("serve_warm_batch_demotes") == 1
+        doc = load_manifest(obs.last_manifest_path())
+        assert not doc["degraded"]
+
+    def test_injected_fault_demotes_batch_cold_stays_bitwise(
+            self, monkeypatch, obs_on):
+        """Satellite: serve_warm_batch fault mid-round — only the batched
+        warm group demotes (serve_warm ladder, stamped degraded); the
+        round's cold requests complete bit-identically."""
+        rng = np.random.RandomState(34)
+        warm_specs = [_make_spec(i, rng) for i in range(2)]
+        cold_spec = _make_spec(7, rng, name="latecomer")
+        cold_solo = survey.measure_source_toas(cold_spec, phShiftRes=200)
+        deltafold.clear_cache()
+        eng = _engine(warm_batch=1)
+        with obs.run("serve_warm_fault"):
+            _register(eng, warm_specs)
+            monkeypatch.setenv("CRIMP_TPU_FAULTS",
+                               "device:serve_warm_batch:1")
+            faultinject.reset()
+            for s in warm_specs:
+                eng.submit(_reissue(s, f0_bump=1e-11))
+            eng.submit(cold_spec)
+            res = eng.step()
+        _assert_contract(res)
+        by_id = {r.client_id: r for r in res}
+        for s in warm_specs:
+            assert by_id[s.name].status == "degraded"
+            assert by_id[s.name].rung == scheduler_mod.WARM_RUNG
+        cold = by_id["latecomer"]
+        assert cold.status == "ok"
+        assert cold.rung == "batched"
+        _assert_bitwise(cold.frame, cold_solo, "latecomer")
+        doc = load_manifest(obs.last_manifest_path())
+        assert doc["degraded"]
+        assert any(d.startswith("serve_warm:solo:device_lost")
+                   for d in doc["degradations"])
+        assert doc["counters"]["serve_warm_batch_demotes"] == 2
+
+    def test_failed_seed_keeps_the_client_cold(self, monkeypatch):
+        """Satellite: warmth is contingent on the fold cache CONFIRMING a
+        stored product — with the cache tier off, a returning client must
+        re-dispatch cold, never down a guaranteed-miss warm path."""
+        monkeypatch.setenv("CRIMP_TPU_FOLD_CACHE", "0")
+        rng = np.random.RandomState(35)
+        specs = [_make_spec(i, rng) for i in range(2)]
+        eng = _engine()
+        _register(eng, specs)
+        assert eng.stats()["warm_clients"] == 0
+        for s in specs:
+            eng.submit(_reissue(s))
+        again = eng.step()
+        assert all(r.status == "ok" for r in again)
+        # still the cold batched rung: no warm path without a product
+        assert [r.rung for r in again] == ["batched", "batched"]
+
+
+# ---------------------------------------------------------------------------
+# prep overlap
+# ---------------------------------------------------------------------------
+
+
+class TestPrepOverlap:
+    def test_overlap_is_bitwise_with_serial_prep(self, obs_on):
+        rng = np.random.RandomState(36)
+        specs = [_make_spec(i, rng) for i in range(3)]
+
+        def arm(pin):
+            deltafold.clear_cache()
+            eng = _engine(prep_overlap=pin)
+            for s in specs:
+                eng.submit(s)
+            return eng.step()
+
+        with obs.run("serve_prep_ab"):
+            serial = arm(False)
+            overlapped = arm(True)
+        assert all(r.status == "ok" for r in serial + overlapped)
+        for a, b in zip(serial, overlapped):
+            assert a.client_id == b.client_id
+            _assert_bitwise(b.frame, a.frame, a.client_id)
+
+    def test_knob_pins_serial_prep(self, monkeypatch):
+        eng = _engine()
+        assert eng._prep_overlap_on()  # default: overlap
+        monkeypatch.setenv("CRIMP_TPU_SERVE_PREP_OVERLAP", "0")
+        assert not eng._prep_overlap_on()
+        monkeypatch.setenv("CRIMP_TPU_SERVE_PREP_OVERLAP", "1")
+        assert eng._prep_overlap_on()
+        # constructor pin wins over the env
+        assert not _engine(prep_overlap=False)._prep_overlap_on()
+        rng = np.random.RandomState(37)
+        monkeypatch.setenv("CRIMP_TPU_SERVE_PREP_OVERLAP", "0")
+        eng2 = _engine()
+        eng2.submit(_make_spec(0, rng))
+        assert not eng2._prep_futures  # serial: nothing scheduled ahead
+
+
+# ---------------------------------------------------------------------------
+# priority classes + weighted fair queueing
+# ---------------------------------------------------------------------------
+
+
+class TestPriorities:
+    def test_unknown_priority_is_a_data_error(self):
+        rng = np.random.RandomState(38)
+        q = AdmissionQueue(capacity=4)
+        with pytest.raises(AdmissionRejected) as e:
+            q.offer(TimingRequest(spec=_make_spec(0, rng),
+                                  priority="urgent"))
+        assert e.value.kind is FailureKind.DATA_ERROR
+
+    def test_per_class_bounds_isolate_backpressure(self):
+        """A saturated low class rejects ITS OWN arrivals; high-priority
+        admission is untouched (no starvation at the front door)."""
+        rng = np.random.RandomState(39)
+        q = AdmissionQueue(capacity=2)
+        for i in range(2):
+            q.offer(TimingRequest(spec=_make_spec(i, rng), priority="low"))
+        with pytest.raises(AdmissionRejected) as e:
+            q.offer(TimingRequest(spec=_make_spec(2, rng), priority="low"))
+        assert e.value.kind is FailureKind.RESOURCE_EXHAUSTED
+        # the low flood never consumed high's budget
+        req = q.offer(TimingRequest(spec=_make_spec(3, rng),
+                                    priority="high"))
+        assert req.priority == "high"
+        assert len(q) == 3
+
+    def test_drain_is_weighted_deficit_round_robin(self):
+        rng = np.random.RandomState(40)
+        q = AdmissionQueue(capacity=8)
+        for cls in ("low", "normal", "high"):  # arrival order != drain
+            for i in range(4):
+                q.offer(TimingRequest(spec=_make_spec(
+                    i, rng, name=f"{cls}{i}"), priority=cls))
+        order = [r.client_id for r in q.drain()]
+        # round 1: high x4 (quantum 4), normal x2, low x1; round 2: the
+        # remaining normals then low; rounds 3-4: the low tail — every
+        # backlogged class progresses each round, FIFO within a class
+        assert order == ["high0", "high1", "high2", "high3",
+                         "normal0", "normal1", "low0",
+                         "normal2", "normal3", "low1", "low2", "low3"]
+
+    def test_saturating_low_traffic_cannot_starve_high(self, obs_on):
+        """Satellite: a low-priority flood at its class bound delays a
+        high request by at most one quantum — in an engine round the high
+        requests dispatch first and complete ok, with zero high-class
+        rejections."""
+        rng = np.random.RandomState(41)
+        eng = _engine(queue=AdmissionQueue(capacity=4))
+        low_specs = [_make_spec(i, rng, name=f"low{i}") for i in range(4)]
+        for s in low_specs:
+            eng.submit(s, priority="low")
+        with pytest.raises(AdmissionRejected):  # low is saturated...
+            eng.submit(_make_spec(9, rng, name="lowX"), priority="low")
+        high_specs = [_make_spec(10 + i, rng, name=f"high{i}")
+                      for i in range(2)]
+        for s in high_specs:  # ...and high admission is unaffected
+            eng.submit(s, priority="high")
+        with obs.run("serve_starvation"):
+            res = eng.step()
+        assert [r.client_id for r in res[:2]] == ["high0", "high1"]
+        assert all(r.status == "ok" for r in res)
+        by_id = {r.client_id: r for r in res}
+        # bounded delay, not priority inversion: every high latency is
+        # within the round every low request also completed in
+        assert all(by_id[f"high{i}"].latency_s is not None
+                   for i in range(2))
+
+
+# ---------------------------------------------------------------------------
+# dispatch queue mechanics (deque regression)
+# ---------------------------------------------------------------------------
+
+
+class TestDispatchQueueOrder:
+    def _pendings(self, n, members_per_group=2):
+        from types import SimpleNamespace
+
+        from crimp_tpu.serve.engine import _Pending
+
+        items = []
+        for i in range(n):
+            name = f"g{i // members_per_group:03d}m{i % members_per_group}"
+            prep = SimpleNamespace(kind="fourier",
+                                   cfg=f"cfg{i // members_per_group:03d}",
+                                   tpl=SimpleNamespace(n_comp=2),
+                                   max_seg=60)
+            p = _Pending(req=TimingRequest(spec=SimpleNamespace(name=name)))
+            p.prep = prep
+            p.rung = "batched"
+            items.append(p)
+        return items
+
+    def test_200_buckets_keep_results_and_order(self, monkeypatch):
+        """Satellite regression for the list.pop(0) -> deque.popleft()
+        swap: 200 buckets (with injected mid-queue failures exercising
+        the split-retry appendleft path) produce the same per-request
+        results in the same order as the O(n^2) queue did."""
+        from crimp_tpu.serve.engine import ServingEngine
+
+        items = self._pendings(400, members_per_group=2)  # 200 buckets
+        calls = []
+        fail_once = {"cfg007", "cfg123"}
+
+        def stub_compute(ps, phase_lists=None, t_refs=None):
+            names = [p.name for p in ps]
+            calls.append(names)
+            grp = names[0][:4].replace("g", "cfg")
+            if len(ps) > 1 and grp in fail_once:
+                fail_once.discard(grp)
+                raise RuntimeError("injected bucket failure")
+            return ([f"frame-{n}" for n in names],
+                    [None] * len(ps), [None] * len(ps))
+
+        # compute_bucket sees preps; give them the member name to track
+        for p in items:
+            p.prep.name = p.req.client_id
+        monkeypatch.setattr(survey, "compute_bucket", stub_compute)
+        monkeypatch.setattr(ServingEngine, "_seed_client",
+                            lambda self, m, pl, tr: None)
+        eng = _engine()
+        eng._dispatch_buckets(items, "batched",
+                              {"max_pad": 0.3, "batch_cap": 2})
+        assert len(calls) >= 200
+        results = [p.result for p in items]
+        assert all(r is not None for r in results)
+        # results land in input order with the stub's frame for each
+        assert [r.client_id for r in results] == \
+            [p.req.client_id for p in items]
+        for p in items:
+            assert p.result.frame == f"frame-{p.req.client_id}"
+        # the two failed buckets split and completed degraded, in place
+        degraded = [r.client_id for r in results if r.status == "degraded"]
+        assert degraded == ["g007m0", "g007m1", "g123m0", "g123m1"]
+        # split halves retried IMMEDIATELY after the failure (appendleft)
+        i7 = calls.index(["g007m0", "g007m1"])
+        assert calls[i7 + 1] == ["g007m0"] and calls[i7 + 2] == ["g007m1"]
+
+    def test_survey_queue_keeps_frame_order(self, monkeypatch):
+        """The same regression for pipelines/survey.py's bucket queue,
+        driven through _survey_impl with stubbed prep/compute."""
+        from types import SimpleNamespace
+
+        from crimp_tpu.ops import multisource
+
+        n = 200
+        specs = [SimpleNamespace(name=f"s{i:03d}") for i in range(n)]
+
+        def stub_prep(spec, phShiftRes, nbrBins, varyAmps):
+            return SimpleNamespace(kind="fourier", cfg="shared",
+                                   tpl=SimpleNamespace(n_comp=2),
+                                   max_seg=60, name=spec.name,
+                                   seg_times=[np.zeros(1)])
+
+        def stub_buckets(sizes, max_pad_ratio=None, batch_cap=None):
+            return [[j] for j in range(len(sizes))]  # one bucket each
+
+        def stub_compute(ps, phase_lists=None, t_refs=None):
+            return ([f"frame-{p.name}" for p in ps],
+                    [None] * len(ps), [None] * len(ps))
+
+        monkeypatch.setattr(survey, "_prep_source", stub_prep)
+        monkeypatch.setattr(survey, "compute_bucket", stub_compute)
+        monkeypatch.setattr(multisource, "bucket_sources", stub_buckets)
+        frames = survey._survey_impl(specs, 200, 15, False)
+        assert frames == [f"frame-s{i:03d}" for i in range(n)]
+        info = survey.last_survey_info()
+        assert info["bucket_count"] == n
+        assert info["n_batched"] == n
