@@ -3,8 +3,10 @@ static analyzer.
 
 Usage::
 
-    python -m crimp_tpu.analysis [--format json|text] [paths...]
-    bash scripts/lint.sh
+    python -m crimp_tpu.analysis [--format json|text|sarif] [paths...]
+    python -m crimp_tpu.analysis --changed-only      # git-diff scoped report
+    python -m crimp_tpu.analysis --waivers           # waiver inventory table
+    bash scripts/lint.sh [--changed] [--sarif]
 
 Rules (docs/analysis.md has the full contract + waiver syntax):
 
@@ -14,13 +16,28 @@ Rules (docs/analysis.md has the full contract + waiver syntax):
   <-> resumable numeric_mode fingerprint)
 - GL004 dtype discipline (longdouble confined to host-side anchor modules)
 - GL005 order-sensitive reductions in sharded/parity-pinned modules
+- GL006 failure-domain discipline (bare except / swallowed errors outside
+  sanctioned telemetry guards)
+- GL007 sharding-registry discipline (mesh-axis names vs parallel registry)
+- GL008 concurrency discipline (thread-reachable module-global mutations
+  must hold a declared lock; lock-declaring modules guard every mutation)
+- GL009 resilience contract web (LADDERS/FAULT_POINTS <-> degradation and
+  fire sites <-> firing tests <-> docs/robustness.md)
+- GL010 telemetry-surface drift (obs counter/gauge literals <->
+  docs/observability.md <-> consumers; ledger METRICS <-> bench.py)
+
+GL008-GL010 are powered by the cross-file facts layer
+(:mod:`crimp_tpu.analysis.facts`); SARIF 2.1.0 output lives in
+:mod:`crimp_tpu.analysis.sarif`.
 
 The tier-1 gate (tests/test_analysis.py) runs the full rule set over
 crimp_tpu/, scripts/ and bench.py and requires zero unwaived findings.
 """
 
+from crimp_tpu.analysis import facts, sarif
 from crimp_tpu.analysis.cli import main
 from crimp_tpu.analysis.core import RULES, Config, Finding, Report
 from crimp_tpu.analysis.engine import run
 
-__all__ = ["main", "run", "Config", "Finding", "Report", "RULES"]
+__all__ = ["main", "run", "Config", "Finding", "Report", "RULES",
+           "facts", "sarif"]

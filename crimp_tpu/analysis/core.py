@@ -38,6 +38,12 @@ RULES: dict[str, str] = {
              "through resilience.taxonomy or carry a waiver reason",
     "GL007": "sharding-registry discipline: hand-written PartitionSpec outside "
              "parallel/registry.py needs a waiver",
+    "GL008": "concurrency discipline: thread-reachable module-global mutations "
+             "hold a declared lock; lock-declaring modules guard every mutation",
+    "GL009": "resilience contract web (LADDERS/FAULT_POINTS <-> "
+             "record_degradation/fire sites <-> tests <-> docs/robustness.md)",
+    "GL010": "telemetry-surface drift (obs counters/gauges <-> "
+             "docs/observability.md <-> consumers; ledger METRICS <-> bench.py)",
 }
 
 _RULE_LIST = r"GL\d{3}(?:\s*,\s*GL\d{3})*"
@@ -205,6 +211,11 @@ DEFAULT_GL005_MODULES = ("crimp_tpu/parallel/",)
 DEFAULT_GL006_MODULES = ("crimp_tpu/",)
 DEFAULT_GL007_MODULES = ("crimp_tpu/",)
 DEFAULT_GL007_REGISTRY = "crimp_tpu/parallel/registry.py"
+DEFAULT_GL008_MODULES = ("crimp_tpu/",)
+DEFAULT_GL010_MODULES = ("crimp_tpu/",)
+# files whose text counts as "something reads this metric" for GL010
+DEFAULT_TELEMETRY_CONSUMERS = ("crimp_tpu/obs/report.py",
+                               "crimp_tpu/obs/ledger.py")
 
 
 @dataclasses.dataclass
@@ -222,6 +233,13 @@ class Config:
     gl006_modules: tuple[str, ...] = DEFAULT_GL006_MODULES
     gl007_modules: tuple[str, ...] = DEFAULT_GL007_MODULES
     gl007_registry: str = DEFAULT_GL007_REGISTRY
+    gl008_modules: tuple[str, ...] = DEFAULT_GL008_MODULES
+    gl010_modules: tuple[str, ...] = DEFAULT_GL010_MODULES
+    telemetry_consumers: tuple[str, ...] = DEFAULT_TELEMETRY_CONSUMERS
+    observability_md: pathlib.Path | None = None  # default: root/docs/observability.md
+    robustness_md: pathlib.Path | None = None  # default: root/docs/robustness.md
+    tests_dir: pathlib.Path | None = None  # default: root/tests
+    bench_py: pathlib.Path | None = None  # default: root/bench.py
     rules: tuple[str, ...] | None = None  # None = all
 
     def resolved_registry(self) -> dict:
@@ -236,6 +254,18 @@ class Config:
 
     def resolved_resumable(self) -> pathlib.Path:
         return self.resumable_py or self.root / "crimp_tpu" / "ops" / "resumable.py"
+
+    def resolved_observability_md(self) -> pathlib.Path:
+        return self.observability_md or self.root / "docs" / "observability.md"
+
+    def resolved_robustness_md(self) -> pathlib.Path:
+        return self.robustness_md or self.root / "docs" / "robustness.md"
+
+    def resolved_tests_dir(self) -> pathlib.Path:
+        return self.tests_dir or self.root / "tests"
+
+    def resolved_bench_py(self) -> pathlib.Path:
+        return self.bench_py or self.root / "bench.py"
 
     def rule_enabled(self, rule: str) -> bool:
         return self.rules is None or rule in self.rules

@@ -21,6 +21,9 @@ RULE_FUNCS = {
     "GL005": rules.rule_gl005,
     "GL006": rules.rule_gl006,
     "GL007": rules.rule_gl007,
+    "GL008": rules.rule_gl008,
+    "GL009": rules.rule_gl009,
+    "GL010": rules.rule_gl010,
 }
 
 

@@ -1,4 +1,4 @@
-"""graftlint rules GL001/GL002/GL004-GL007 (GL003 lives in knobcheck.py).
+"""graftlint rules GL001/GL002/GL004-GL010 (GL003 lives in knobcheck.py).
 
 Each rule is a function ``(cfg, sources, project) -> list[Finding]``
 over the parsed scan set. The rules encode invariants the repo's kernel
@@ -34,12 +34,33 @@ GL007  sharding-registry discipline — ``PartitionSpec(...)`` written by
        carry a waiver: specs scattered across call sites are exactly the
        bespoke-sharded-twin drift the registry exists to end (dispatch
        sites ask ``registry.specs_for(kernel, mesh)`` instead).
+GL008  concurrency discipline — a module-level global mutated from code
+       reachable from a thread spawn / executor callback must hold a
+       declared module lock, and a module that declares such a lock
+       keeps ALL its global mutations lock-guarded (the obs/core.py
+       ``_LOCK`` and profiling ``_TIMES_LOCK`` patterns, enforced).
+       Intentionally lock-free paths carry a mandatory-reason waiver.
+GL009  resilience contract web — LADDERS engine/rung pairs, the
+       FAULT_POINTS registry, their ``record_degradation()``/``fire()``
+       call sites, firing tests in tests/, and docs/robustness.md are
+       cross-checked in all directions (the GL003 pattern, applied to
+       the resilience layer).
+GL010  telemetry-surface drift — every obs counter/gauge literal is
+       unique, documented in docs/observability.md, and consumed by
+       obs/report.py, obs/ledger.py or a test (or waived); dynamic
+       f-string families document their static prefix; every ledger
+       METRICS key names a bench-record field bench.py produces.
+
+GL008-GL010 consume the cross-file facts layer (analysis/facts.py).
 """
 
 from __future__ import annotations
 
 import ast
+import pathlib
+import re
 
+from crimp_tpu.analysis import facts as facts_mod
 from crimp_tpu.analysis.callgraph import (
     FunctionInfo,
     Project,
@@ -341,4 +362,234 @@ def rule_gl006(cfg: Config, sources: dict[str, SourceFile],
                 "retry/degradation policy sees its FailureKind, or waive "
                 "with the reason this handler is a deliberate swallow "
                 "domain"))
+    return out
+
+
+# -- GL008/GL009/GL010 helpers ------------------------------------------------
+
+
+def _in_modules(rel: str, modules: tuple[str, ...]) -> bool:
+    return any(rel == m or rel.startswith(m) for m in modules)
+
+
+def _mentions(text: str, name: str) -> bool:
+    """Word-boundary-ish containment: ``grid`` must not match
+    ``grid_mxu`` (identifier characters end the word)."""
+    return re.search(r"(?<![A-Za-z0-9_])" + re.escape(name)
+                     + r"(?![A-Za-z0-9_])", text) is not None
+
+
+def _read_optional(path: pathlib.Path) -> str:
+    try:
+        return path.read_text(encoding="utf-8", errors="replace")
+    except OSError:
+        return ""
+
+
+def _tests_corpus(cfg: Config) -> str:
+    """Concatenated text of tests/*.py — the 'is there a test touching
+    this name' side of the GL009/GL010 webs."""
+    tests_dir = cfg.resolved_tests_dir()
+    if not tests_dir.is_dir():
+        return ""
+    return "\n".join(_read_optional(p) for p in sorted(tests_dir.glob("*.py")))
+
+
+# -- GL008 -------------------------------------------------------------------
+
+
+def rule_gl008(cfg: Config, sources: dict[str, SourceFile],
+               project: Project) -> list[Finding]:
+    pf = facts_mod.for_project(project)
+    reachable = pf.thread_reachable()
+    out: list[Finding] = []
+    for rel in sorted(pf.modules):
+        if not _in_modules(rel, cfg.gl008_modules):
+            continue
+        mf = pf.modules[rel]
+        lock_list = ", ".join(sorted(mf.locks)) or None
+        for m in mf.mutations:
+            if m.locks_held:
+                continue
+            if f"{rel}:{m.func}" in reachable:
+                out.append(Finding(
+                    "GL008", rel, m.line,
+                    f"module global {m.name!r} mutated ({m.how}) in "
+                    f"{m.func}(), which runs off the main thread (reachable "
+                    "from a Thread target / executor callback), without "
+                    "holding a declared lock — guard it with a module "
+                    "threading.Lock or waive with the lock-free argument"))
+            elif lock_list is not None:
+                out.append(Finding(
+                    "GL008", rel, m.line,
+                    f"module global {m.name!r} mutated ({m.how}) in "
+                    f"{m.func}() outside any `with` on a declared lock "
+                    f"({lock_list}) — a lock-declaring module keeps every "
+                    "global mutation guarded, or waives the site with the "
+                    "single-threaded argument"))
+    return out
+
+
+# -- GL009 -------------------------------------------------------------------
+
+
+def rule_gl009(cfg: Config, sources: dict[str, SourceFile],
+               project: Project) -> list[Finding]:
+    pf = facts_mod.for_project(project)
+    ladders, lad_rel, lad_line = pf.ladders()
+    points, pts_rel, pts_line = pf.fault_points()
+    rob_path = cfg.resolved_robustness_md()
+    rob_rel = rob_path.name if rob_path.parent.name != "docs" \
+        else f"docs/{rob_path.name}"
+    rob = _read_optional(rob_path)
+    tests = _tests_corpus(cfg)
+    out: list[Finding] = []
+
+    deg_literal = {(s.engine, s.rung)
+                   for s in pf.degradation_sites() if s.engine and s.rung}
+    for engine, rungs in sorted(ladders.items()):
+        # rungs[0] is the normal (non-degraded) path — reaching it never
+        # goes through record_degradation, so only fallback rungs need a
+        # call site
+        for rung in rungs[1:]:
+            if (engine, rung) not in deg_literal:
+                out.append(Finding(
+                    "GL009", lad_rel, lad_line,
+                    f"LADDERS[{engine!r}] rung {rung!r} has no "
+                    f"record_degradation({engine!r}, {rung!r}, ...) call "
+                    "site in the scan set — an unreachable rung is dead "
+                    "policy"))
+        for name in dict.fromkeys((engine, *rungs)):
+            if not _mentions(rob, name):
+                out.append(Finding(
+                    "GL009", lad_rel, lad_line,
+                    f"ladder name {name!r} (engine {engine!r}) is missing "
+                    f"from {rob_rel} — the degradation-ladder table is the "
+                    "operator contract"))
+    if ladders:
+        for s in pf.degradation_sites():
+            if s.engine is None or s.rung is None:
+                continue  # dynamic args — validated at runtime by policy.py
+            if s.engine not in ladders:
+                out.append(Finding(
+                    "GL009", s.rel, s.line,
+                    f"record_degradation names unregistered engine "
+                    f"{s.engine!r} — every engine degrades along a declared "
+                    "LADDERS entry"))
+            elif s.rung not in ladders[s.engine]:
+                out.append(Finding(
+                    "GL009", s.rel, s.line,
+                    f"record_degradation names rung {s.rung!r} not in "
+                    f"LADDERS[{s.engine!r}] {ladders[s.engine]!r}"))
+
+    fired = {f.point for f in pf.fire_sites() if f.point}
+    for point in sorted(points):
+        if point not in fired:
+            out.append(Finding(
+                "GL009", pts_rel, pts_line,
+                f"fault point {point!r} has no fire({point!r}) site in the "
+                "scan set — an unfireable point cannot be chaos-tested"))
+        if f":{point}:" not in tests:
+            out.append(Finding(
+                "GL009", pts_rel, pts_line,
+                f"fault point {point!r} has no firing test in tests/ "
+                f"(no 'kind:{point}:n' fault spec) — every recovery path "
+                "is exercised in CI, not discovered in production"))
+        if not _mentions(rob, point):
+            out.append(Finding(
+                "GL009", pts_rel, pts_line,
+                f"fault point {point!r} is missing from {rob_rel}"))
+    if points:
+        for f in pf.fire_sites():
+            if f.point is not None and f.point not in points:
+                out.append(Finding(
+                    "GL009", f.rel, f.line,
+                    f"fire() names unregistered fault point {f.point!r} — "
+                    "the FAULT_POINTS registry is closed"))
+    return out
+
+
+# -- GL010 -------------------------------------------------------------------
+
+
+def rule_gl010(cfg: Config, sources: dict[str, SourceFile],
+               project: Project) -> list[Finding]:
+    pf = facts_mod.for_project(project)
+    obs_path = cfg.resolved_observability_md()
+    obs_rel = obs_path.name if obs_path.parent.name != "docs" \
+        else f"docs/{obs_path.name}"
+    obs_doc = _read_optional(obs_path)
+    consumers = _tests_corpus(cfg) + "\n" + "\n".join(
+        _read_optional(cfg.root / rel) for rel in cfg.telemetry_consumers)
+    out: list[Finding] = []
+
+    emits = [m for m in pf.metric_emits()
+             if _in_modules(m.rel, cfg.gl010_modules)]
+    # first emission site per literal name (stable anchor for waivers)
+    first: dict[tuple[str, str], facts_mod.MetricEmit] = {}
+    kinds_by_name: dict[str, set[str]] = {}
+    for m in sorted(emits, key=lambda m: (m.rel, m.line)):
+        if m.name is None:
+            continue
+        first.setdefault((m.kind, m.name), m)
+        if m.kind in ("counter", "gauge"):
+            kinds_by_name.setdefault(m.name, set()).add(m.kind)
+
+    for name, kinds in sorted(kinds_by_name.items()):
+        if len(kinds) > 1:
+            m = min((first[(k, name)] for k in kinds),
+                    key=lambda m: (m.rel, m.line))
+            out.append(Finding(
+                "GL010", m.rel, m.line,
+                f"metric name {name!r} is emitted as both "
+                f"{' and '.join(sorted(kinds))} — names are unique across "
+                "metric types"))
+
+    for (kind, name), m in sorted(first.items()):
+        if kind == "beat":
+            continue  # heartbeat labels are phase tags, not ledger metrics
+        if not _mentions(obs_doc, name):
+            out.append(Finding(
+                "GL010", m.rel, m.line,
+                f"{kind} {name!r} is not documented in {obs_rel} — every "
+                "emitted metric has an inventory row"))
+        if not _mentions(consumers, name):
+            out.append(Finding(
+                "GL010", m.rel, m.line,
+                f"{kind} {name!r} is emitted but never consumed by "
+                "obs/report.py, obs/ledger.py or a test — dead telemetry "
+                "drifts silently; consume it or waive with the reason it "
+                "is operator-facing only"))
+
+    seen_dynamic: set[tuple[str, str]] = set()
+    for m in sorted(emits, key=lambda m: (m.rel, m.line)):
+        if m.name is not None or m.kind == "beat":
+            continue
+        if not m.prefix:
+            out.append(Finding(
+                "GL010", m.rel, m.line,
+                f"{m.kind} name at this site is not a string literal or "
+                "prefixed f-string — the telemetry surface must be "
+                "statically enumerable; use a literal family prefix or "
+                "waive with the reason"))
+            continue
+        if (m.kind, m.prefix) in seen_dynamic:
+            continue
+        seen_dynamic.add((m.kind, m.prefix))
+        if m.prefix not in obs_doc:
+            out.append(Finding(
+                "GL010", m.rel, m.line,
+                f"dynamic {m.kind} family with prefix {m.prefix!r} is not "
+                f"documented in {obs_rel} — document the "
+                f"'{m.prefix}<...>' pattern"))
+
+    ledger, led_rel, led_line = pf.ledger_metrics()
+    bench_text = _read_optional(cfg.resolved_bench_py())
+    for key, field in sorted(ledger.items()):
+        if not _mentions(bench_text, field):
+            out.append(Finding(
+                "GL010", led_rel, led_line,
+                f"ledger metric {key!r} reads bench-record field {field!r} "
+                f"but {cfg.resolved_bench_py().name} never produces it — a "
+                "gate metric nothing feeds can never ratchet"))
     return out

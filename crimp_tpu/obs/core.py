@@ -500,7 +500,11 @@ def run(name: str, **attrs):
         with _LOCK:
             _RUN = None
         _stack().clear()
-        _LAST_MANIFEST = rec.finalize()
+        # finalize (manifest I/O) outside the lock; publish under it so a
+        # reader on another thread never sees a torn last-manifest pointer
+        manifest = rec.finalize()
+        with _LOCK:
+            _LAST_MANIFEST = manifest
 
 
 def span(name: str, kind: str = "stage", **attrs):

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 
 from crimp_tpu import knobs
@@ -146,7 +147,10 @@ def beat(done: float, total: float | None, label: str | None = None,
                                       "frac", "rate_per_s", "eta_s",
                                       "span", "backend")}})
     if hb["path"] is not None:
-        tmp = hb["path"] + ".tmp"
+        # per-thread tmp name: two threads beating concurrently must not
+        # replace each other's tmp file out from under the open() — the
+        # final os.replace is atomic either way, last writer wins
+        tmp = hb["path"] + f".{threading.get_ident()}.tmp"
         try:
             with open(tmp, "w", encoding="utf-8") as fh:
                 json.dump(doc, fh, indent=1, default=str)

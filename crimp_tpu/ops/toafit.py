@@ -35,6 +35,7 @@ while_loop instead of sequential refits.
 
 from __future__ import annotations
 
+import threading
 from functools import partial
 from typing import NamedTuple
 
@@ -805,18 +806,23 @@ def fit_toas_batch_auto(
 # Sortedness results keyed by array identity so repeated interval slicing of
 # the SAME event array (the measure_toas / GTI pattern) pays the O(n) check
 # once. The stored base-array reference keeps id() stable and valid; a
-# single-slot cache bounds memory to one retained event array.
+# single-slot cache bounds memory to one retained event array. The lock
+# matters: slice_sorted_intervals runs on the serve prep-overlap worker
+# thread, so an unguarded clear()+store could tear against the main thread.
+_SORTED_LOCK = threading.Lock()
 _SORTED_CACHE: dict[int, tuple[np.ndarray, bool]] = {}
 
 
 def _is_sorted_cached(times: np.ndarray) -> bool:
     key = id(times)
-    hit = _SORTED_CACHE.get(key)
-    if hit is not None and hit[0] is times:
-        return hit[1]
+    with _SORTED_LOCK:
+        hit = _SORTED_CACHE.get(key)
+        if hit is not None and hit[0] is times:
+            return hit[1]
     ok = bool(np.all(np.diff(times) >= 0))
-    _SORTED_CACHE.clear()
-    _SORTED_CACHE[key] = (times, ok)
+    with _SORTED_LOCK:
+        _SORTED_CACHE.clear()
+        _SORTED_CACHE[key] = (times, ok)
     return ok
 
 

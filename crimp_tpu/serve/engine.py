@@ -135,6 +135,7 @@ class ServingEngine:
         self._prep_overlap = prep_overlap
         self._prep_pool: concurrent.futures.ThreadPoolExecutor | None = None
         self._prep_futures: dict[int, concurrent.futures.Future] = {}
+        self._closed = False
         self.counts = {"ok": 0, "degraded": 0, "error": 0,
                        "deadline_miss": 0, "steps": 0}
         # capacity note: the (optionally global, multi-host) mesh the
@@ -174,12 +175,32 @@ class ServingEngine:
 
         return crimp_tpu.warmup(**kwargs)
 
+    def close(self) -> None:
+        """Shut the engine down deterministically: the prep-overlap worker
+        thread is joined (never leaked past the engine's lifetime), pending
+        prep futures are dropped, and subsequent :meth:`submit` calls are
+        refused with a classified :class:`AdmissionRejected`. Idempotent."""
+        self._closed = True
+        pool, self._prep_pool = self._prep_pool, None
+        self._prep_futures.clear()
+        if pool is not None:
+            pool.shutdown(wait=True, cancel_futures=True)
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def submit(self, spec, deadline_s: float | None = None,
                priority: str = "normal") -> TimingRequest:
         """Admit one request (a survey ``SourceSpec`` or a prebuilt
         :class:`TimingRequest`); raises :class:`AdmissionRejected` with a
         taxonomy kind on refusal.  ``priority`` picks the admission
         class (high / normal / low — serve/admission.py)."""
+        if self._closed:
+            raise AdmissionRejected(
+                "engine is closed", FailureKind.RESOURCE_EXHAUSTED)
         req = spec if isinstance(spec, TimingRequest) \
             else TimingRequest(spec=spec, deadline_s=deadline_s,
                                priority=priority)

@@ -150,21 +150,26 @@ def install_compile_listeners() -> bool:
             return False
 
     def _on_event(event: str, **kw) -> None:
+        # jax may emit monitoring events from compilation worker threads
         if event.startswith("/jax/compilation_cache/"):
             key = event.rsplit("/", 1)[-1]
-            _COMPILE_EVENTS[key] = _COMPILE_EVENTS.get(key, 0) + 1
+            with _TIMES_LOCK:
+                _COMPILE_EVENTS[key] = _COMPILE_EVENTS.get(key, 0) + 1
 
     def _on_duration(event: str, duration: float, **kw) -> None:
         if event.startswith(("/jax/compilation_cache/", "/jax/core/compile/")):
             key = event.rsplit("/", 1)[-1]
-            _COMPILE_DURATIONS[key] = _COMPILE_DURATIONS.get(key, 0.0) + duration
+            with _TIMES_LOCK:
+                _COMPILE_DURATIONS[key] = (
+                    _COMPILE_DURATIONS.get(key, 0.0) + duration)
 
     try:
         monitoring.register_event_listener(_on_event)
         monitoring.register_event_duration_secs_listener(_on_duration)
     except Exception:  # noqa: BLE001 — telemetry must never break import  # graftlint: disable=GL006 (telemetry guard: jax.monitoring listeners are optional; failing to install them must not break import)
         return False
-    _LISTENERS_INSTALLED = True
+    with _TIMES_LOCK:
+        _LISTENERS_INSTALLED = True
     return True
 
 
@@ -184,8 +189,9 @@ def compile_counters() -> dict:
 
 
 def reset_compile_counters() -> None:
-    _COMPILE_EVENTS.clear()
-    _COMPILE_DURATIONS.clear()
+    with _TIMES_LOCK:
+        _COMPILE_EVENTS.clear()
+        _COMPILE_DURATIONS.clear()
 
 
 @contextlib.contextmanager

@@ -6,9 +6,28 @@
 #   bash scripts/lint.sh                 # scan crimp_tpu/ scripts/ bench.py
 #   bash scripts/lint.sh --format json   # machine-readable report
 #   bash scripts/lint.sh --baseline f    # fail only on findings new vs f
+#   bash scripts/lint.sh --changed       # report only git-changed files
+#   bash scripts/lint.sh --sarif         # SARIF 2.1.0 on stdout
+#
+# --changed/--sarif are shorthands for --changed-only/--format sarif and
+# combine (--changed --sarif = changed-scope SARIF). Everything else is
+# passed through to python -m crimp_tpu.analysis verbatim.
+#
+# Pre-commit: see docs/analysis.md for the hook recipe
+# (scripts/lint.sh --changed as a pre-commit gate).
 #
 # Exit codes: 0 clean, 1 unwaived findings, 2 usage error.
 set -euo pipefail
 
 cd "$(dirname "$0")/.."
-exec python -m crimp_tpu.analysis "$@"
+
+args=()
+for arg in "$@"; do
+  case "$arg" in
+    --changed) args+=(--changed-only) ;;
+    --sarif)   args+=(--format sarif) ;;
+    *)         args+=("$arg") ;;
+  esac
+done
+
+exec python -m crimp_tpu.analysis "${args[@]}"

@@ -286,6 +286,50 @@ class TestThreadSafety:
         doc = load_manifest(obs.last_manifest_path())
         assert doc["counters"]["hits"] == 1600
 
+    def test_eight_thread_mixed_stress_exact_counts(self, obs_on):
+        """The GL008 dynamic companion: 8 threads hammer every shared
+        surface the concurrency rules guard at once — counters, gauges,
+        forced heartbeats, and timed() kernels — and every count must be
+        exact. A dropped lock on any of the four paths shows up as a
+        lost update here long before it shows up in production."""
+        profiling.reset_kernel_times()
+        n_threads, n_each = 8, 25
+
+        def work(tid):
+            for i in range(n_each):
+                obs.counter_add("stress_shared")
+                obs.counter_add(f"stress_t{tid}")
+                obs.gauge_set("stress_gauge", tid)
+                with profiling.timed("stress_kernel"):
+                    pass
+                obs.beat(i + 1, n_each, label="stress", force=True)
+
+        with obs.run("stress"):
+            threads = [threading.Thread(target=work, args=(tid,))
+                       for tid in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        assert len(profiling.kernel_times()["stress_kernel"]) == \
+            n_threads * n_each
+        path = obs.last_manifest_path()
+        doc = load_manifest(path)
+        assert doc["counters"]["stress_shared"] == n_threads * n_each
+        for tid in range(n_threads):
+            assert doc["counters"][f"stress_t{tid}"] == n_each
+        assert doc["gauges"]["stress_gauge"] in set(range(n_threads))
+        kernels = [s for s in doc["spans"] if s["name"] == "stress_kernel"]
+        assert len(kernels) == n_threads * n_each
+        # every forced beat emits exactly one heartbeat event
+        events = pathlib.Path(
+            str(path)[: -len(".manifest.json")] + ".events.jsonl")
+        beats = [json.loads(line) for line in
+                 events.read_text(encoding="utf-8").splitlines()
+                 if json.loads(line).get("ev") == "heartbeat"]
+        assert len(beats) == n_threads * n_each
+        assert validate_manifest(doc) == []
+
 
 # ---------------------------------------------------------------------------
 # Reporter: diff, trace, prometheus

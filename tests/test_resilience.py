@@ -345,6 +345,18 @@ class TestFaultInjector:
                 faultinject.fire(point)
             assert taxonomy.classify(e.value) is kind
 
+    def test_fold_sources_point_fires_on_real_fold_path(self, monkeypatch):
+        # fires from the chunk loop inside multisource.fold_sources — the
+        # instrumentation point itself, not a bare fire() call, so moving
+        # the point out of the fold path would turn this red
+        monkeypatch.setenv("CRIMP_TPU_FAULTS", "oom:fold_sources:1")
+        faultinject.reset()
+        tms = [{"PEPOCH": 58000.0, "F0": 0.14, "F1": -1e-13}]
+        segs = [[np.linspace(58000.0, 58000.1, 32)]]
+        with pytest.raises(taxonomy.InjectedFault) as e:
+            multisource.fold_sources(tms, segs)
+        assert taxonomy.classify(e.value) is FailureKind.RESOURCE_EXHAUSTED
+
     def test_other_points_unaffected(self, monkeypatch):
         monkeypatch.setenv("CRIMP_TPU_FAULTS", "nan:fold_cache:1")
         faultinject.fire("scan_chunk")

@@ -815,6 +815,75 @@ class TestPrepOverlap:
         eng2.submit(_make_spec(0, rng))
         assert not eng2._prep_futures  # serial: nothing scheduled ahead
 
+    def test_env_knob_parity_under_concurrent_rounds(self, monkeypatch,
+                                                     obs_on):
+        """Three rounds (cold batch, warm cache re-timing, perturbed
+        delta re-timing) with admissions landing while the previous
+        round's prep futures are still draining: the overlapped arm must
+        be bitwise identical to CRIMP_TPU_SERVE_PREP_OVERLAP=0, round by
+        round and column by column."""
+        rng = np.random.RandomState(38)
+        specs = [_make_spec(i, rng) for i in range(3)]
+
+        def arm(env):
+            monkeypatch.setenv("CRIMP_TPU_SERVE_PREP_OVERLAP", env)
+            deltafold.clear_cache()
+            eng = _engine()
+            rounds = []
+            for s in specs:
+                eng.submit(s)
+            rounds.append(eng.step())
+            # reissues admitted back-to-back: with overlap on, the prep
+            # worker is still chewing on these while step() dispatches
+            for s in specs:
+                eng.submit(_reissue(s))
+            for s in specs:
+                eng.submit(_reissue(s, f0_bump=1e-11))
+            rounds.append(eng.step())
+            return rounds
+
+        with obs.run("serve_prep_env_ab"):
+            serial = arm("0")
+            overlapped = arm("1")
+        for r_serial, r_over in zip(serial, overlapped):
+            assert [r.status for r in r_serial] == \
+                [r.status for r in r_over] == ["ok"] * len(r_serial)
+            for a, b in zip(r_serial, r_over):
+                assert a.client_id == b.client_id
+                assert a.path == b.path
+                _assert_bitwise(b.frame, a.frame, a.client_id)
+
+
+class TestLifecycle:
+    def test_close_is_deterministic_and_idempotent(self):
+        rng = np.random.RandomState(39)
+        eng = _engine(prep_overlap=True)
+        eng.submit(_make_spec(0, rng))
+        assert eng._prep_pool is not None
+        worker_threads = list(eng._prep_pool._threads)
+        eng.close()
+        # the prep worker is joined, not leaked past the engine
+        assert all(not t.is_alive() for t in worker_threads)
+        assert eng._prep_pool is None and not eng._prep_futures
+        eng.close()  # idempotent
+
+    def test_closed_engine_rejects_with_taxonomy_kind(self):
+        rng = np.random.RandomState(40)
+        eng = _engine()
+        eng.close()
+        with pytest.raises(AdmissionRejected) as exc:
+            eng.submit(_make_spec(0, rng))
+        assert exc.value.kind is FailureKind.RESOURCE_EXHAUSTED
+
+    def test_context_manager_closes(self):
+        rng = np.random.RandomState(41)
+        with _engine(prep_overlap=True) as eng:
+            # exit with a prep future still pending: close() must drop it
+            eng.submit(_make_spec(0, rng))
+        assert eng._prep_pool is None and not eng._prep_futures
+        with pytest.raises(AdmissionRejected):
+            eng.submit(_make_spec(1, rng))
+
 
 # ---------------------------------------------------------------------------
 # priority classes + weighted fair queueing

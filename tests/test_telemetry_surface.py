@@ -1,0 +1,164 @@
+"""Two-directional pin of the telemetry surface.
+
+GL010 checks each emitted counter/gauge name against the inventory in
+docs/observability.md and against this corpus; this test closes the loop
+from the other side: the EXPECTED sets below are asserted *equal* to what
+the facts layer extracts from the real tree, so
+
+- a new emission that nobody added to the inventory turns this red
+  (and GL010 red, independently), and
+- a deleted emission whose row was left behind turns this red too —
+  the failure GL010 alone cannot see.
+
+Pure AST analysis: never imports jax or any crimp_tpu runtime module.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+
+import pytest
+
+from crimp_tpu.analysis import facts as facts_mod
+from crimp_tpu.analysis.callgraph import Project
+from crimp_tpu.analysis.core import Config, collect_files
+from crimp_tpu.analysis.engine import load_source
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+# Every counter name a literal counter_add() call in the tree may use.
+EXPECTED_COUNTERS = frozenset({
+    "autotune_cache_hits",
+    "autotune_cache_misses",
+    "bucket_count",
+    "chunks_computed",
+    "chunks_resumed",
+    "costmodel_capture_errors",
+    "costmodel_rows",
+    "degradations",
+    "delta_fold_cache_hits",
+    "delta_fold_exact_folds",
+    "delta_fold_guard_trips",
+    "delta_fold_nonlinear_fallbacks",
+    "delta_fold_refold_failures",
+    "delta_fold_refolds",
+    "delta_fold_seeded",
+    "ephem_windows_fit",
+    "events_folded",
+    "fold_segments",
+    "grid_mxu_reseeds",
+    "grid_trials",
+    "mcmc_delta_path_steps",
+    "mcmc_guard_fallbacks",
+    "mcmc_proposals_evaluated",
+    "mcmc_sources_batched",
+    "mesh_grid3d_fallbacks",
+    "mesh_sharded_calls",
+    "pad_cells_total",
+    "pad_cells_used",
+    "quarantined_files",
+    "retries",
+    "retries_deadline_skipped",
+    "semicoherent_segments",
+    "serve_admitted",
+    "serve_breaker_close",
+    "serve_breaker_half_open",
+    "serve_breaker_open",
+    "serve_breaker_reopen",
+    "serve_breaker_shed",
+    "serve_deadline_miss",
+    "serve_errors",
+    "serve_preemptive_degrades",
+    "serve_queue_full",
+    "serve_rejected",
+    "serve_warm_batch_demotes",
+    "serve_warm_batched",
+    "sources_batched",
+    "toas_fit",
+    "toas_fit_input",
+})
+
+# Every gauge name a literal gauge_set() call in the tree may use.
+EXPECTED_GAUGES = frozenset({
+    "bucket_occupancy_pct",
+    "mesh_devices",
+    "serve_prep_overlap_ready",
+})
+
+# Every dynamic f-string family, by (kind, prefix).
+EXPECTED_FAMILIES = frozenset({
+    ("counter", "degraded_"),
+    ("counter", "quarantined_"),
+    ("counter", "retries_"),
+    ("counter", "serve_"),
+    ("counter", "serve_admitted_"),
+    ("counter", "serve_breaker_close_"),
+    ("counter", "serve_breaker_half_open_"),
+    ("counter", "serve_breaker_open_"),
+    ("counter", "serve_breaker_reopen_"),
+    ("counter", "serve_warm_"),
+})
+
+
+@pytest.fixture(scope="module")
+def project_facts():
+    cfg = Config(root=ROOT, paths=[pathlib.Path("crimp_tpu"),
+                                   pathlib.Path("scripts"),
+                                   pathlib.Path("bench.py")])
+    files = collect_files(cfg.paths, cfg.root)
+    sources = {}
+    for f in files:
+        src = load_source(f, cfg.root)
+        sources[src.rel] = src
+    project = Project({rel: s.tree for rel, s in sources.items()
+                       if s.is_python and s.tree is not None})
+    return facts_mod.for_project(project)
+
+
+def _emitted(project_facts, kind):
+    return {m.name for m in project_facts.metric_emits()
+            if m.kind == kind and m.name is not None}
+
+
+class TestTelemetrySurface:
+    def test_counter_inventory_is_exact(self, project_facts):
+        emitted = _emitted(project_facts, "counter")
+        assert emitted - EXPECTED_COUNTERS == set(), \
+            "new counters: add an inventory row in docs/observability.md " \
+            "and to EXPECTED_COUNTERS here"
+        assert EXPECTED_COUNTERS - emitted == set(), \
+            "stale rows: these counters are in the inventory but no code " \
+            "emits them any more"
+
+    def test_gauge_inventory_is_exact(self, project_facts):
+        emitted = _emitted(project_facts, "gauge")
+        assert emitted == EXPECTED_GAUGES
+
+    def test_family_inventory_is_exact(self, project_facts):
+        fams = {(m.kind, m.prefix) for m in project_facts.metric_emits()
+                if m.kind in ("counter", "gauge") and m.name is None
+                and m.prefix}
+        assert fams == EXPECTED_FAMILIES
+
+    def test_no_unenumerable_emissions(self, project_facts):
+        # every dynamic emission must at least carry a literal prefix —
+        # a fully-computed name is invisible to the whole contract web
+        bad = [(m.rel, m.line) for m in project_facts.metric_emits()
+               if m.kind in ("counter", "gauge") and m.name is None
+               and not m.prefix]
+        assert bad == []
+
+    def test_names_unique_across_kinds(self):
+        assert EXPECTED_COUNTERS & EXPECTED_GAUGES == set()
+
+    def test_every_name_documented(self):
+        doc = (ROOT / "docs" / "observability.md").read_text(encoding="utf-8")
+        missing = [n for n in sorted(EXPECTED_COUNTERS | EXPECTED_GAUGES)
+                   if not re.search(
+                       r"(?<![A-Za-z0-9_])" + re.escape(n) + r"(?![A-Za-z0-9_])",
+                       doc)]
+        assert missing == [], f"not in docs/observability.md: {missing}"
+        missing_fams = [p for _, p in sorted(EXPECTED_FAMILIES)
+                        if p not in doc]
+        assert missing_fams == []
